@@ -1,0 +1,12 @@
+"""tensor2robot_tpu — a TPU-native robot-learning framework.
+
+A ground-up JAX/XLA/Pallas re-design with the capabilities of
+google-research/tensor2robot (surveyed in SURVEY.md): declarative tensor
+specs that derive parsers, random test data, serving signatures and
+sharding; spec-driven input pipelines; an abstract model interface with
+regression / classification / critic bases; a pjit-sharded train/eval
+orchestrator with async export and polling predictors; a MAML wrapper;
+and the research model families (pose_env, QT-Opt, Grasp2Vec, VRGripper).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,23 @@
+"""gin-compatible configuration system (reference: gin-config usage throughout t2r).
+
+Import as ``from tensor2robot_tpu import config as gin`` for
+reference-style ``@gin.configurable`` / ``gin.parse_config_files_and_bindings``.
+"""
+
+from tensor2robot_tpu.config.ginlite import (
+    GinError,
+    REQUIRED,
+    add_config_file_search_path,
+    bind_parameter,
+    clear_config,
+    config_scope,
+    config_str,
+    configurable,
+    external_configurable,
+    operative_config_str,
+    parse_config,
+    parse_config_file,
+    parse_config_files_and_bindings,
+    parse_value,
+    query_parameter,
+)

@@ -1,0 +1,589 @@
+"""ginlite — a small gin-config-compatible dependency-injection engine.
+
+The reference framework is wired together entirely with gin-config
+(SURVEY.md §6: "gin-config is the backbone" — every model / generator /
+preprocessor / optimizer is `@gin.configurable`, experiments are `.gin`
+files, binaries take `--gin_configs` / `--gin_bindings` flags). gin is not
+available in this environment, so we provide an in-tree engine that speaks
+the same surface for the subset the framework and its experiment configs
+use:
+
+  * ``@configurable`` decorator (optional name / module / denylist)
+  * ``parse_config_files_and_bindings(config_files, bindings)``
+  * binding lines      ``module.fn.param = <value>``
+  * macros             ``NAME = <value>`` and ``%NAME`` references
+  * configurable refs  ``@fn`` (inject the configured callable) and
+                       ``@fn()`` (inject its call result)
+  * scopes             ``scope/fn.param = value`` with ``@scope/fn`` refs
+                       and the ``config_scope('scope')`` context manager
+  * ``include '<file>'`` and ``import a.b.c`` statements
+  * ``REQUIRED`` sentinel, ``bind_parameter``, ``query_parameter``,
+    ``clear_config``, ``operative_config_str``
+
+Values use Python literal syntax (via ``ast``), with ``@ref`` / ``%macro``
+allowed anywhere a literal may appear, including inside containers.
+"""
+
+from __future__ import annotations
+
+import ast
+import contextlib
+import functools
+import importlib
+import inspect
+import os
+import re
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+
+class GinError(Exception):
+  pass
+
+
+class _Required:
+  """Sentinel: a configurable parameter that MUST be bound via config."""
+
+  def __repr__(self):
+    return "REQUIRED"
+
+
+REQUIRED = _Required()
+
+
+class _Registry:
+  """Global registry of configurables, bindings, and macros."""
+
+  def __init__(self):
+    self.configurables: Dict[str, "_Configurable"] = {}
+    # bindings[(scope, configurable_name)][param] = raw value (already
+    # parsed into python objects / _Reference / _Macro placeholders).
+    self.bindings: Dict[Tuple[str, str], Dict[str, Any]] = {}
+    self.macros: Dict[str, Any] = {}
+    self.imported_modules: List[str] = []
+    self.lock = threading.RLock()
+    # names actually used at call time, for operative_config_str.
+    self.operative: Dict[Tuple[str, str], Dict[str, Any]] = {}
+
+
+_REGISTRY = _Registry()
+_SCOPE_STACK = threading.local()
+
+
+def _scope_stack() -> List[str]:
+  if not hasattr(_SCOPE_STACK, "stack"):
+    _SCOPE_STACK.stack = []
+  return _SCOPE_STACK.stack
+
+
+@contextlib.contextmanager
+def config_scope(name: str):
+  """Activates a gin scope for configurable calls within the block."""
+  if name:
+    _scope_stack().append(name)
+  try:
+    yield
+  finally:
+    if name:
+      _scope_stack().pop()
+
+
+class _Reference:
+  """A parsed `@name` or `@scope/name` or `@name()` value."""
+
+  __slots__ = ("name", "scope", "evaluate")
+
+  def __init__(self, name: str, scope: str, evaluate: bool):
+    self.name = name
+    self.scope = scope
+    self.evaluate = evaluate
+
+  def resolve(self):
+    cfg = _lookup_configurable(self.name)
+    if cfg is None:
+      raise GinError(f"Unknown configurable reference: @{self.name}")
+    if self.scope:
+      fn = cfg.scoped_callable(self.scope)
+    else:
+      fn = cfg.wrapper
+    return fn() if self.evaluate else fn
+
+  def __repr__(self):
+    scope = f"{self.scope}/" if self.scope else ""
+    call = "()" if self.evaluate else ""
+    return f"@{scope}{self.name}{call}"
+
+
+class _Macro:
+  """A parsed `%NAME` value."""
+
+  __slots__ = ("name",)
+
+  def __init__(self, name: str):
+    self.name = name
+
+  def resolve(self):
+    if self.name not in _REGISTRY.macros:
+      raise GinError(f"Undefined macro: %{self.name}")
+    return _resolve(_REGISTRY.macros[self.name])
+
+  def __repr__(self):
+    return f"%{self.name}"
+
+
+def _resolve(value: Any) -> Any:
+  """Recursively resolves references and macros inside parsed values."""
+  if isinstance(value, _Reference) or isinstance(value, _Macro):
+    return value.resolve()
+  if isinstance(value, list):
+    return [_resolve(v) for v in value]
+  if isinstance(value, tuple):
+    return tuple(_resolve(v) for v in value)
+  if isinstance(value, dict):
+    return {_resolve(k): _resolve(v) for k, v in value.items()}
+  return value
+
+
+class _Configurable:
+  """Wraps one configurable function or class."""
+
+  def __init__(self, fn: Callable, name: str, module: str,
+               denylist: Sequence[str]):
+    self.fn = fn
+    self.name = name
+    self.module = module
+    self.denylist = tuple(denylist or ())
+    self.wrapper = self._make_wrapper()
+
+  @property
+  def full_name(self) -> str:
+    return f"{self.module}.{self.name}" if self.module else self.name
+
+  def _signature_params(self):
+    target = self.fn.__init__ if inspect.isclass(self.fn) else self.fn
+    try:
+      sig = inspect.signature(target)
+    except (TypeError, ValueError):
+      return {}, False
+    params = {}
+    has_kwargs = False
+    for p in sig.parameters.values():
+      if p.kind == inspect.Parameter.VAR_KEYWORD:
+        has_kwargs = True
+      elif p.kind in (inspect.Parameter.POSITIONAL_OR_KEYWORD,
+                      inspect.Parameter.KEYWORD_ONLY):
+        params[p.name] = p
+    params.pop("self", None)
+    return params, has_kwargs
+
+  def gather_bindings(self, scope_stack: Sequence[str]) -> Dict[str, Any]:
+    """Merges unscoped then progressively-scoped bindings (inner wins)."""
+    merged: Dict[str, Any] = {}
+    with _REGISTRY.lock:
+      for key in [("", self.name), ("", self.full_name)]:
+        merged.update(_REGISTRY.bindings.get(key, {}))
+      # Apply each active scope, outermost to innermost, then compound
+      # scopes like 'a/b'.
+      for i in range(len(scope_stack)):
+        for j in range(i, len(scope_stack)):
+          scope = "/".join(scope_stack[i:j + 1])
+          for key in [(scope, self.name), (scope, self.full_name)]:
+            merged.update(_REGISTRY.bindings.get(key, {}))
+    return merged
+
+  def _make_wrapper(self) -> Callable:
+    configurable = self
+
+    if inspect.isclass(self.fn):
+      # Subclass-preserving wrapper: inject into __init__.
+      orig_init = self.fn.__init__
+
+      @functools.wraps(orig_init)
+      def wrapped_init(obj, *args, **kwargs):
+        merged = configurable._inject(args, kwargs)
+        orig_init(obj, *args, **merged)
+
+      wrapped_cls = self.fn
+      wrapped_cls.__init__ = wrapped_init
+      return wrapped_cls
+
+    @functools.wraps(self.fn)
+    def wrapper(*args, **kwargs):
+      merged = configurable._inject(args, kwargs)
+      return configurable.fn(*args, **merged)
+
+    return wrapper
+
+  def _inject(self, args: tuple, kwargs: dict) -> dict:
+    params, has_kwargs = self._signature_params()
+    bindings = self.gather_bindings(tuple(_scope_stack()))
+    merged = dict(kwargs)
+    positional = set(list(params)[:len(args)])
+    used: Dict[str, Any] = {}
+    for name, raw in bindings.items():
+      if name in self.denylist:
+        raise GinError(
+            f"Parameter {name!r} of {self.full_name} is in the denylist "
+            f"and cannot be configured.")
+      if name in positional or name in kwargs:
+        continue  # explicit caller args win over config
+      if name not in params and not has_kwargs:
+        raise GinError(
+            f"Configurable {self.full_name} has no parameter {name!r}.")
+      merged[name] = _resolve(raw)
+      used[name] = raw
+    # REQUIRED enforcement: any declared-REQUIRED param still unbound?
+    for name, p in params.items():
+      if p.default is REQUIRED and name not in merged and \
+          name not in positional:
+        raise GinError(
+            f"Required parameter {self.full_name}.{name} was not bound. "
+            f"Bind it via '{self.name}.{name} = ...'.")
+    if used:
+      with _REGISTRY.lock:
+        scope = "/".join(_scope_stack())
+        _REGISTRY.operative.setdefault((scope, self.name), {}).update(used)
+    return merged
+
+  def scoped_callable(self, scope: str) -> Callable:
+    wrapper = self.wrapper
+
+    @functools.wraps(self.fn)
+    def scoped(*args, **kwargs):
+      with contextlib.ExitStack() as stack:
+        for part in scope.split("/"):
+          stack.enter_context(config_scope(part))
+        return wrapper(*args, **kwargs)
+
+    return scoped
+
+
+def configurable(fn_or_name=None, *, module: Optional[str] = None,
+                 denylist: Optional[Sequence[str]] = None,
+                 allowlist: Optional[Sequence[str]] = None):
+  """Registers a function or class as configurable (gin.configurable API).
+
+  Note: `allowlist` is accepted for API parity; enforcement treats all
+  non-allowlisted parameters as denylisted.
+  """
+
+  def decorate(fn, name=None):
+    reg_name = name or fn.__name__
+    deny = list(denylist or [])
+    if allowlist is not None:
+      params = [p for p in inspect.signature(
+          fn.__init__ if inspect.isclass(fn) else fn).parameters
+                if p != "self"]
+      deny.extend(p for p in params if p not in allowlist)
+    cfg = _Configurable(fn, reg_name, module or _infer_module(fn), deny)
+    with _REGISTRY.lock:
+      _REGISTRY.configurables[reg_name] = cfg
+      _REGISTRY.configurables[cfg.full_name] = cfg
+    return cfg.wrapper
+
+  if callable(fn_or_name):
+    return decorate(fn_or_name)
+  return lambda fn: decorate(fn, name=fn_or_name)
+
+
+def external_configurable(fn, name=None, module=None, **kwargs):
+  """Registers an external callable (gin.external_configurable API)."""
+  reg_name = name or getattr(fn, "__name__", str(fn))
+  cfg = _Configurable(fn, reg_name, module or _infer_module(fn), ())
+  with _REGISTRY.lock:
+    _REGISTRY.configurables[reg_name] = cfg
+    _REGISTRY.configurables[cfg.full_name] = cfg
+  return cfg.wrapper
+
+
+def _infer_module(fn) -> str:
+  mod = getattr(fn, "__module__", "") or ""
+  return mod.rsplit(".", 1)[-1] if mod else ""
+
+
+def _lookup_configurable(name: str) -> Optional[_Configurable]:
+  with _REGISTRY.lock:
+    if name in _REGISTRY.configurables:
+      return _REGISTRY.configurables[name]
+    # Allow partial module-qualified lookups: match unique suffix.
+    matches = {c for n, c in _REGISTRY.configurables.items()
+               if n.endswith("." + name)}
+    if len(matches) == 1:
+      return matches.pop()
+  return None
+
+
+# ---------------------------------------------------------------------------
+# Parsing
+# ---------------------------------------------------------------------------
+
+_REF_RE = re.compile(r"@([A-Za-z_][\w.]*(?:/[A-Za-z_][\w.]*)*)(\(\))?")
+_MACRO_RE = re.compile(r"%([A-Za-z_][\w.]*)")
+
+
+def _tokenize_value(text: str) -> Tuple[str, Dict[str, Any]]:
+  """Replaces @refs and %macros outside string literals with placeholders."""
+  out = []
+  placeholders: Dict[str, Any] = {}
+  i = 0
+  counter = 0
+  in_string: Optional[str] = None
+  while i < len(text):
+    ch = text[i]
+    if in_string:
+      out.append(ch)
+      if ch == "\\":
+        if i + 1 < len(text):
+          out.append(text[i + 1])
+          i += 1
+      elif ch == in_string:
+        in_string = None
+      i += 1
+      continue
+    if ch in "\"'":
+      in_string = ch
+      out.append(ch)
+      i += 1
+      continue
+    if ch == "@":
+      m = _REF_RE.match(text, i)
+      if not m:
+        raise GinError(f"Malformed reference in value: {text!r}")
+      full = m.group(1)
+      evaluate = m.group(2) is not None
+      scope, _, name = full.rpartition("/")
+      key = f"__GINREF_{counter}__"
+      counter += 1
+      placeholders[key] = _Reference(name, scope, evaluate)
+      out.append(f"'{key}'")
+      i = m.end()
+      continue
+    if ch == "%":
+      m = _MACRO_RE.match(text, i)
+      if not m:
+        raise GinError(f"Malformed macro in value: {text!r}")
+      key = f"__GINMACRO_{counter}__"
+      counter += 1
+      placeholders[key] = _Macro(m.group(1))
+      out.append(f"'{key}'")
+      i = m.end()
+      continue
+    out.append(ch)
+    i += 1
+  return "".join(out), placeholders
+
+
+def _restore_placeholders(value: Any, placeholders: Dict[str, Any]) -> Any:
+  if isinstance(value, str) and value in placeholders:
+    return placeholders[value]
+  if isinstance(value, list):
+    return [_restore_placeholders(v, placeholders) for v in value]
+  if isinstance(value, tuple):
+    return tuple(_restore_placeholders(v, placeholders) for v in value)
+  if isinstance(value, dict):
+    return {_restore_placeholders(k, placeholders):
+            _restore_placeholders(v, placeholders)
+            for k, v in value.items()}
+  return value
+
+
+_NAMED_CONSTANTS = {
+    "None": None, "True": True, "False": False,
+    "inf": float("inf"), "nan": float("nan"),
+}
+
+
+def parse_value(text: str) -> Any:
+  """Parses one gin value expression into a python object."""
+  text = text.strip()
+  if text in _NAMED_CONSTANTS:
+    return _NAMED_CONSTANTS[text]
+  replaced, placeholders = _tokenize_value(text)
+  try:
+    value = ast.literal_eval(replaced)
+  except (ValueError, SyntaxError) as e:
+    # Bare identifiers (gin allows dotted names as strings in some spots).
+    if re.fullmatch(r"[A-Za-z_][\w.]*", text):
+      return text
+    raise GinError(f"Cannot parse value: {text!r} ({e})") from e
+  return _restore_placeholders(value, placeholders)
+
+
+def bind_parameter(binding_name: str, value: Any) -> None:
+  """Binds `scope/configurable.param` to an (already-python) value."""
+  scope, name, param = _split_binding_name(binding_name)
+  with _REGISTRY.lock:
+    _REGISTRY.bindings.setdefault((scope, name), {})[param] = value
+
+
+def query_parameter(binding_name: str) -> Any:
+  scope, name, param = _split_binding_name(binding_name)
+  with _REGISTRY.lock:
+    try:
+      return _REGISTRY.bindings[(scope, name)][param]
+    except KeyError:
+      raise GinError(f"No binding for {binding_name!r}") from None
+
+
+def _split_binding_name(binding_name: str) -> Tuple[str, str, str]:
+  scope, _, rest = binding_name.rpartition("/")
+  if "." not in rest:
+    raise GinError(f"Invalid binding name: {binding_name!r}")
+  name, _, param = rest.rpartition(".")
+  return scope, name, param
+
+
+_STATEMENT_RE = re.compile(
+    r"^(?P<target>[\w./]+(?:\.[\w]+)?)\s*=\s*(?P<value>.+)$", re.DOTALL)
+
+
+def parse_config(config: str, skip_unknown: bool = False) -> None:
+  """Parses gin-format config text into the global registry."""
+  lines = config.split("\n")
+  # Join continuation lines: a statement continues while brackets are open
+  # or the line ends with an operator.
+  statements: List[str] = []
+  buf = ""
+  depth = 0
+  for raw in lines:
+    line = raw.split("#", 1)[0].rstrip()
+    if not line.strip() and depth == 0:
+      continue
+    buf = (buf + "\n" + line) if buf else line
+    depth = _bracket_depth(buf)
+    if depth == 0 and not buf.rstrip().endswith((",", "=", "\\")):
+      statements.append(buf.strip())
+      buf = ""
+  if buf.strip():
+    statements.append(buf.strip())
+
+  for stmt in statements:
+    _parse_statement(stmt, skip_unknown=skip_unknown)
+
+
+def _bracket_depth(text: str) -> int:
+  depth = 0
+  in_string = None
+  i = 0
+  while i < len(text):
+    ch = text[i]
+    if in_string:
+      if ch == "\\":
+        i += 1
+      elif ch == in_string:
+        in_string = None
+    elif ch in "\"'":
+      in_string = ch
+    elif ch in "([{":
+      depth += 1
+    elif ch in ")]}":
+      depth -= 1
+    i += 1
+  return depth
+
+
+def _parse_statement(stmt: str, skip_unknown: bool = False) -> None:
+  if stmt.startswith("import "):
+    module = stmt[len("import "):].strip()
+    try:
+      importlib.import_module(module)
+      _REGISTRY.imported_modules.append(module)
+    except ImportError:
+      if not skip_unknown:
+        raise
+    return
+  if stmt.startswith("include "):
+    path = parse_value(stmt[len("include "):].strip())
+    parse_config_file(path, skip_unknown=skip_unknown)
+    return
+  m = _STATEMENT_RE.match(stmt)
+  if not m:
+    raise GinError(f"Cannot parse config statement: {stmt!r}")
+  target = m.group("target").strip()
+  value = parse_value(m.group("value").strip())
+  scope, _, rest = target.rpartition("/")
+  if "." not in rest:
+    # Macro definition: NAME = value
+    with _REGISTRY.lock:
+      _REGISTRY.macros[target] = value
+    return
+  name, _, param = rest.rpartition(".")
+  if not skip_unknown or _lookup_configurable(name) is not None:
+    with _REGISTRY.lock:
+      _REGISTRY.bindings.setdefault((scope, name), {})[param] = value
+
+
+_SEARCH_PATHS: List[str] = [""]
+
+
+def add_config_file_search_path(path: str) -> None:
+  _SEARCH_PATHS.append(path)
+
+
+def parse_config_file(path: str, skip_unknown: bool = False) -> None:
+  for base in _SEARCH_PATHS:
+    candidate = os.path.join(base, path) if base else path
+    if os.path.exists(candidate):
+      with open(candidate) as f:
+        parse_config(f.read(), skip_unknown=skip_unknown)
+      return
+  raise GinError(f"Config file not found: {path!r} "
+                 f"(search paths: {_SEARCH_PATHS})")
+
+
+def parse_config_files_and_bindings(
+    config_files: Optional[Sequence[str]] = None,
+    bindings: Optional[Sequence[str]] = None,
+    skip_unknown: bool = False,
+    finalize_config: bool = True,  # accepted for API parity
+) -> None:
+  for path in config_files or []:
+    parse_config_file(path, skip_unknown=skip_unknown)
+  for binding in bindings or []:
+    parse_config(binding, skip_unknown=skip_unknown)
+
+
+def clear_config() -> None:
+  with _REGISTRY.lock:
+    _REGISTRY.bindings.clear()
+    _REGISTRY.macros.clear()
+    _REGISTRY.operative.clear()
+
+
+def _format_value(value: Any) -> str:
+  if isinstance(value, (_Reference, _Macro)):
+    return repr(value)
+  if isinstance(value, tuple):
+    inner = ", ".join(_format_value(v) for v in value)
+    return f"({inner},)" if len(value) == 1 else f"({inner})"
+  if isinstance(value, list):
+    return "[" + ", ".join(_format_value(v) for v in value) + "]"
+  if isinstance(value, dict):
+    return "{" + ", ".join(
+        f"{_format_value(k)}: {_format_value(v)}"
+        for k, v in value.items()) + "}"
+  return repr(value)
+
+
+def config_str() -> str:
+  """All current bindings and macros, in parseable gin syntax."""
+  out = []
+  with _REGISTRY.lock:
+    for name, value in sorted(_REGISTRY.macros.items()):
+      out.append(f"{name} = {_format_value(value)}")
+    for (scope, name), params in sorted(_REGISTRY.bindings.items()):
+      prefix = f"{scope}/" if scope else ""
+      for param, value in sorted(params.items()):
+        out.append(f"{prefix}{name}.{param} = {_format_value(value)}")
+  return "\n".join(out) + ("\n" if out else "")
+
+
+def operative_config_str() -> str:
+  """Bindings actually consumed by configurable calls so far."""
+  out = []
+  with _REGISTRY.lock:
+    for (scope, name), params in sorted(_REGISTRY.operative.items()):
+      prefix = f"{scope}/" if scope else ""
+      for param, value in sorted(params.items()):
+        out.append(f"{prefix}{name}.{param} = {_format_value(value)}")
+  return "\n".join(out) + ("\n" if out else "")

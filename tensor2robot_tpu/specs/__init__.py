@@ -1,0 +1,35 @@
+"""Declarative tensor-spec system (reference: tensor2robot utils/tensorspec_utils.py)."""
+
+from tensor2robot_tpu.specs.tensorspec import (
+    ExtendedTensorSpec,
+    TensorSpec,
+    TensorSpecStruct,
+    PATH_SEP,
+)
+from tensor2robot_tpu.specs.packing import (
+    SpecValidationError,
+    add_sequence_length,
+    assert_valid_spec_structure,
+    filter_required_flat_tensor_spec_structure,
+    flatten_spec_structure,
+    pack_flat_sequence_to_spec_structure,
+    replace_dtype,
+    to_shape_dtype_structs,
+    validate_and_flatten,
+    validate_and_pack,
+)
+from tensor2robot_tpu.specs.serialization import (
+    ASSET_FILENAME,
+    deserialize_assets,
+    read_assets,
+    serialize_assets,
+    spec_from_dict,
+    spec_to_dict,
+    struct_from_dict,
+    struct_to_dict,
+    write_assets,
+)
+from tensor2robot_tpu.specs.random_data import (
+    make_random_tensors,
+    random_array_for_spec,
+)

@@ -1,0 +1,245 @@
+"""Validation and packing of tensors against spec structures.
+
+Reference parity: tensor2robot `utils/tensorspec_utils.py` —
+`flatten_spec_structure`, `validate_and_pack`, `validate_and_flatten`,
+`filter_required_flat_tensor_spec_structure`,
+`pack_flat_sequence_to_spec_structure` (file:line cites unavailable; see
+SURVEY.md provenance note).
+
+The contract these functions enforce is the framework's backbone: a model
+declares specs; data pipelines produce flat dicts of arrays; before any
+array reaches a jitted step it is validated (shape/dtype, modulo batch and
+time prefixes) and packed into a `TensorSpecStruct` whose layout matches
+the declaration. Optional specs may be absent; required specs must match.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from tensor2robot_tpu.specs.tensorspec import (
+    PATH_SEP,
+    ExtendedTensorSpec,
+    TensorSpecStruct,
+)
+
+
+class SpecValidationError(ValueError):
+  """Raised when tensors do not conform to their declared specs."""
+
+
+def is_leaf_spec(value: Any) -> bool:
+  return isinstance(value, ExtendedTensorSpec)
+
+
+def flatten_spec_structure(spec_structure: Any) -> TensorSpecStruct:
+  """Flattens an arbitrarily nested structure into a TensorSpecStruct.
+
+  Accepts TensorSpecStruct, mappings, named tuples, and (nested) lists /
+  tuples; list positions become string indices, matching the reference's
+  behavior of admitting arbitrary nests.
+  """
+  flat: dict = {}
+
+  def visit(prefix: str, node: Any):
+    if isinstance(node, TensorSpecStruct):
+      for k, v in node.to_flat_dict().items():
+        flat_key = f"{prefix}{PATH_SEP}{k}" if prefix else k
+        flat[flat_key] = v
+    elif isinstance(node, Mapping):
+      for k, v in node.items():
+        flat_key = f"{prefix}{PATH_SEP}{k}" if prefix else str(k)
+        visit(flat_key, v)
+    elif hasattr(node, "_asdict"):  # namedtuple
+      visit(prefix, node._asdict())
+    elif isinstance(node, (list, tuple)):
+      for i, v in enumerate(node):
+        flat_key = f"{prefix}{PATH_SEP}{i}" if prefix else str(i)
+        visit(flat_key, v)
+    else:
+      if not prefix:
+        raise SpecValidationError(
+            "Cannot flatten a bare leaf without a key.")
+      flat[prefix] = node
+
+  visit("", spec_structure)
+  return TensorSpecStruct.from_flat_dict(flat)
+
+
+def assert_valid_spec_structure(spec_structure: Any) -> None:
+  """Asserts every leaf is an ExtendedTensorSpec."""
+  flat = flatten_spec_structure(spec_structure)
+  for key, leaf in flat.to_flat_dict().items():
+    if not is_leaf_spec(leaf):
+      raise SpecValidationError(
+          f"Spec structure leaf {key!r} is not an ExtendedTensorSpec: "
+          f"{type(leaf)}")
+
+
+def filter_required_flat_tensor_spec_structure(
+    spec_structure: Any) -> TensorSpecStruct:
+  """Returns only the non-optional specs, flattened."""
+  flat = flatten_spec_structure(spec_structure)
+  return TensorSpecStruct.from_flat_dict({
+      k: v for k, v in flat.to_flat_dict().items() if not v.is_optional
+  })
+
+
+def _check_leaf(
+    key: str,
+    spec: ExtendedTensorSpec,
+    array: Any,
+    batch_prefix_dims: int,
+) -> None:
+  """Validates one array against one spec, ignoring leading prefix dims."""
+  shape = tuple(array.shape)
+  expected = tuple(spec.shape)
+  # Sequence tensors carry one extra (time) axis inside the prefix.
+  prefix = batch_prefix_dims + (1 if spec.is_sequence else 0)
+  if len(shape) != prefix + len(expected):
+    raise SpecValidationError(
+        f"{key!r}: rank mismatch — got shape {shape}, expected "
+        f"{prefix} prefix dim(s) + {expected} (spec {spec!r}).")
+  if shape[prefix:] != expected:
+    raise SpecValidationError(
+        f"{key!r}: shape mismatch — got {shape}, expected trailing dims "
+        f"{expected} (spec {spec!r}).")
+  got_dtype = np.dtype(array.dtype) if array.dtype != jax.numpy.bfloat16 \
+      else jax.numpy.bfloat16.dtype
+  if spec.is_image:
+    # Encoded images arrive as uint8 bytes or already-decoded uint8/float.
+    return
+  if got_dtype != spec.dtype:
+    raise SpecValidationError(
+        f"{key!r}: dtype mismatch — got {got_dtype}, expected "
+        f"{np.dtype(spec.dtype)}.")
+
+
+def validate_and_flatten(
+    spec_structure: Any,
+    tensors: Any,
+    ignore_batch: bool = True,
+) -> TensorSpecStruct:
+  """Validates tensors against specs; returns them flat, spec-ordered.
+
+  Optional specs may be missing from `tensors`; required specs must be
+  present and conforming. Extra tensors not covered by any spec are
+  dropped (reference semantics: the spec is the contract, the data may be
+  a superset).
+
+  Args:
+    spec_structure: nested structure of ExtendedTensorSpec.
+    tensors: nested structure of arrays with matching keys.
+    ignore_batch: if True, arrays have one leading batch dim not present
+      in the (logical, unbatched) specs.
+  """
+  flat_specs = flatten_spec_structure(spec_structure)
+  flat_tensors = flatten_spec_structure(tensors)
+  spec_dict = flat_specs.to_flat_dict()
+  tensor_dict = flat_tensors.to_flat_dict()
+  prefix = 1 if ignore_batch else 0
+
+  out: dict = {}
+  missing = []
+  for key, spec in spec_dict.items():
+    if not is_leaf_spec(spec):
+      raise SpecValidationError(
+          f"Spec leaf {key!r} is not an ExtendedTensorSpec.")
+    if key in tensor_dict:
+      _check_leaf(key, spec, tensor_dict[key], prefix)
+      out[key] = tensor_dict[key]
+    elif spec.is_optional:
+      continue
+    else:
+      missing.append(key)
+  if missing:
+    raise SpecValidationError(
+        f"Required specs missing from tensors: {missing}. "
+        f"Available keys: {list(tensor_dict)}")
+  return TensorSpecStruct.from_flat_dict(out)
+
+
+def validate_and_pack(
+    spec_structure: Any,
+    tensors: Any,
+    ignore_batch: bool = True,
+) -> TensorSpecStruct:
+  """Validates and returns tensors packed in the spec structure's layout."""
+  flat = validate_and_flatten(spec_structure, tensors, ignore_batch)
+  packed = TensorSpecStruct()
+  for key, value in flat.to_flat_dict().items():
+    packed[key] = value
+  return packed
+
+
+def pack_flat_sequence_to_spec_structure(
+    spec_structure: Any,
+    flat_sequence: Sequence[Any],
+) -> TensorSpecStruct:
+  """Packs a flat sequence of leaves against the spec's leaf order."""
+  flat_specs = flatten_spec_structure(spec_structure).to_flat_dict()
+  if len(flat_specs) != len(flat_sequence):
+    raise SpecValidationError(
+        f"Leaf count mismatch: {len(flat_specs)} specs vs "
+        f"{len(flat_sequence)} tensors.")
+  out = TensorSpecStruct()
+  for key, value in zip(flat_specs.keys(), flat_sequence):
+    out[key] = value
+  return out
+
+
+def replace_dtype(
+    spec_structure: Any,
+    from_dtype: Any,
+    to_dtype: Any,
+) -> TensorSpecStruct:
+  """Returns a copy of the spec structure with dtypes swapped.
+
+  Used by the TPU-compat preprocessor wrapper to declare uint8 wire specs
+  with bfloat16/float32 model-side specs.
+  """
+  flat = flatten_spec_structure(spec_structure).to_flat_dict()
+  from_dtype = np.dtype(from_dtype) if from_dtype != jax.numpy.bfloat16 \
+      else jax.numpy.bfloat16.dtype
+  out = {}
+  for key, spec in flat.items():
+    if spec.dtype == from_dtype:
+      out[key] = spec.replace(dtype=to_dtype)
+    else:
+      out[key] = spec
+  return TensorSpecStruct.from_flat_dict(out)
+
+
+def to_shape_dtype_structs(
+    spec_structure: Any,
+    batch_size: Optional[int] = None,
+    sequence_length: Optional[int] = None,
+) -> TensorSpecStruct:
+  """Maps a spec structure to jax.ShapeDtypeStruct leaves (for eval_shape)."""
+  flat = flatten_spec_structure(spec_structure).to_flat_dict()
+  return TensorSpecStruct.from_flat_dict({
+      k: v.to_shape_dtype_struct(batch_size, sequence_length)
+      for k, v in flat.items()
+  })
+
+
+def add_sequence_length(
+    spec_structure: Any, sequence_length: int) -> TensorSpecStruct:
+  """Materializes sequence specs to fixed-length specs (time-major-after-batch).
+
+  XLA requires static shapes; episode pipelines pad/truncate to a fixed
+  `sequence_length` and this helper rewrites `is_sequence` specs to their
+  padded concrete shapes.
+  """
+  flat = flatten_spec_structure(spec_structure).to_flat_dict()
+  out = {}
+  for key, spec in flat.items():
+    if spec.is_sequence:
+      out[key] = spec.replace(
+          shape=(sequence_length,) + tuple(spec.shape), is_sequence=False)
+    else:
+      out[key] = spec
+  return TensorSpecStruct.from_flat_dict(out)

@@ -1,0 +1,69 @@
+"""Spec-driven random data generation — the test backbone.
+
+Reference parity: tensor2robot's `DefaultRandomInputGenerator` /
+`make_random_numpy`-style helpers (input_generators/ and utils/
+tensorspec_utils.py [U]; SURVEY.md §5): every framework integration test
+runs on random spec-conforming data, so no datasets are needed to exercise
+the full train/eval/export path. We reproduce that contract with numpy
+RNG (host-side; feeding real pipelines) and keep it deterministic via an
+explicit seed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from tensor2robot_tpu.specs import packing
+from tensor2robot_tpu.specs.tensorspec import (
+    ExtendedTensorSpec,
+    TensorSpecStruct,
+)
+
+
+def random_array_for_spec(
+    spec: ExtendedTensorSpec,
+    rng: np.random.Generator,
+    batch_size: Optional[int] = None,
+    sequence_length: Optional[int] = None,
+) -> np.ndarray:
+  """Draws one random array conforming to `spec`.
+
+  Images (uint8 / image-format specs) are uniform in [0, 255]; floats are
+  standard normal; ints uniform in [0, 10); bools fair coin flips.
+  """
+  shape = tuple(spec.shape)
+  if spec.is_sequence:
+    shape = (sequence_length or 3,) + shape
+  if batch_size is not None:
+    shape = (batch_size,) + shape
+  dtype = np.dtype(spec.dtype) if spec.dtype.kind != "V" else spec.dtype
+  if spec.is_image or dtype == np.uint8:
+    return rng.integers(0, 256, size=shape, dtype=np.uint8).astype(spec.dtype)
+  if dtype.kind == "f" or spec.dtype.name == "bfloat16":
+    return rng.standard_normal(size=shape).astype(spec.dtype)
+  if dtype.kind in ("i", "u"):
+    return rng.integers(0, 10, size=shape).astype(dtype)
+  if dtype.kind == "b":
+    return (rng.random(size=shape) > 0.5)
+  raise ValueError(f"Cannot generate random data for dtype {dtype}")
+
+
+def make_random_tensors(
+    spec_structure: Any,
+    batch_size: Optional[int] = None,
+    sequence_length: Optional[int] = None,
+    seed: int = 0,
+    include_optional: bool = True,
+) -> TensorSpecStruct:
+  """Generates a full random batch conforming to a spec structure."""
+  rng = np.random.default_rng(seed)
+  flat = packing.flatten_spec_structure(spec_structure).to_flat_dict()
+  out = {}
+  for key, spec in flat.items():
+    if spec.is_optional and not include_optional:
+      continue
+    out[key] = random_array_for_spec(
+        spec, rng, batch_size=batch_size, sequence_length=sequence_length)
+  return TensorSpecStruct.from_flat_dict(out)

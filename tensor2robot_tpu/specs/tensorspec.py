@@ -1,0 +1,361 @@
+"""Declarative tensor specifications — the core of the framework.
+
+This is the TPU-native re-design of the reference's spec system
+(reference: tensor2robot `utils/tensorspec_utils.py` — `ExtendedTensorSpec`
+and `TensorSpecStruct`; exact file:line cites unavailable, see SURVEY.md
+provenance note). Models declare their input/output feature and label
+specs declaratively; the framework mechanically derives record parsers,
+random test-data generators, serving signatures, sharding layouts, and
+validation from those declarations.
+
+TPU-first design choices (vs. the reference's TF1 `tf.TensorSpec` subclass):
+
+* Specs are immutable, hashable dataclasses built around *logical* shapes
+  (no batch dim).  They convert directly to `jax.ShapeDtypeStruct` for
+  `jax.eval_shape` / AOT compilation, and carry an optional
+  `jax.sharding.PartitionSpec` so the same declaration that derives the
+  parser also derives the pjit sharding of the batch.
+* `TensorSpecStruct` is registered as a JAX pytree, so entire spec
+  structures (and the batches packed against them) flow through `jax.jit`,
+  `jax.tree_util`, and `pjit` without adapter code.
+* dtypes are numpy/jax dtypes; `bfloat16` is first-class (the reference
+  had to special-case it for TPU).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Iterator, Mapping, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Path separator for nested spec structures, matching the reference's
+# convention of '/'-joined keys in flattened spec structures.
+PATH_SEP = "/"
+
+_VALID_NAME_RE = re.compile(r"^[A-Za-z0-9_.\-]+$")
+
+
+def _normalize_dtype(dtype: Any) -> np.dtype:
+  """Normalizes dtypes, keeping bfloat16 (a numpy extension type) intact."""
+  if dtype is None:
+    raise ValueError("TensorSpec dtype must not be None.")
+  if isinstance(dtype, str) and dtype == "bfloat16":
+    return jnp.bfloat16.dtype
+  if dtype in (jnp.bfloat16, jnp.bfloat16.dtype):
+    return jnp.bfloat16.dtype
+  return np.dtype(dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class ExtendedTensorSpec:
+  """An immutable tensor declaration with data-pipeline metadata.
+
+  Attributes:
+    shape: Logical (unbatched) shape. Entries must be positive ints.
+    dtype: numpy/jax dtype of the tensor *as seen by the model*.
+    name: Wire name; used as the record feature key unless `dataset_key`
+      overrides it and as the serving-signature name.
+    is_optional: Optional tensors may be absent from a dataset; required
+      tensors fail validation when missing.
+    is_sequence: Marks per-timestep tensors (episode data). Sequence
+      tensors get a leading time axis after the batch axis.
+    data_format: For image tensors, the on-disk encoding: 'jpeg', 'png',
+      or None for raw numeric data. Encoded images are stored as strings
+      and decoded host-side before infeed.
+    dataset_key: For multi-dataset input pipelines, the name of the source
+      dataset this tensor is read from ('' = default dataset).
+    varlen: Variable-length feature (ragged on disk); padded/truncated to
+      `shape` at parse time.
+    sharding: Optional `jax.sharding.PartitionSpec` for the *batched*
+      tensor; derived pipelines use it to place the batch on the mesh.
+      None means "replicate / let the trainer's data axis rule apply".
+  """
+
+  shape: Tuple[int, ...]
+  dtype: np.dtype
+  name: Optional[str] = None
+  is_optional: bool = False
+  is_sequence: bool = False
+  data_format: Optional[str] = None
+  dataset_key: str = ""
+  varlen: bool = False
+  sharding: Optional[Any] = None  # jax.sharding.PartitionSpec
+
+  def __post_init__(self):
+    shape = tuple(int(d) for d in self.shape)
+    if any(d <= 0 for d in shape):
+      raise ValueError(
+          f"ExtendedTensorSpec shapes must be fully-defined and positive, "
+          f"got {shape} for name={self.name!r}. Use varlen=True for "
+          f"ragged wire data; logical shapes are static (XLA requirement).")
+    object.__setattr__(self, "shape", shape)
+    object.__setattr__(self, "dtype", _normalize_dtype(self.dtype))
+    if self.name is not None and not _VALID_NAME_RE.match(self.name):
+      raise ValueError(f"Invalid spec name: {self.name!r}")
+    if self.data_format is not None and self.data_format not in (
+        "jpeg", "png", "raw"):
+      raise ValueError(f"Unsupported data_format: {self.data_format!r}")
+
+  # ---- constructors ----
+
+  @classmethod
+  def from_spec(cls, spec: "ExtendedTensorSpec", **overrides) -> (
+      "ExtendedTensorSpec"):
+    """Copy-with-overrides, mirroring the reference's from_spec API."""
+    kwargs = dict(
+        shape=spec.shape,
+        dtype=spec.dtype,
+        name=spec.name,
+        is_optional=spec.is_optional,
+        is_sequence=spec.is_sequence,
+        data_format=spec.data_format,
+        dataset_key=spec.dataset_key,
+        varlen=spec.varlen,
+        sharding=spec.sharding,
+    )
+    kwargs.update(overrides)
+    return cls(**kwargs)
+
+  @classmethod
+  def from_array(cls, array: Any, name: Optional[str] = None) -> (
+      "ExtendedTensorSpec"):
+    """Builds a spec describing a concrete (unbatched) array."""
+    arr = np.asarray(array) if not hasattr(array, "dtype") else array
+    return cls(shape=tuple(arr.shape), dtype=arr.dtype, name=name)
+
+  # ---- conversions ----
+
+  def to_shape_dtype_struct(
+      self, batch_size: Optional[int] = None,
+      sequence_length: Optional[int] = None) -> jax.ShapeDtypeStruct:
+    """The jax-native view of this spec, optionally batched.
+
+    This is what feeds `jax.eval_shape` and AOT lowering: the same
+    declaration the data layer parses against also drives compilation.
+    """
+    shape = self.shape
+    if self.is_sequence and sequence_length is not None:
+      shape = (sequence_length,) + shape
+    if batch_size is not None:
+      shape = (batch_size,) + shape
+    return jax.ShapeDtypeStruct(shape, self.dtype)
+
+  @property
+  def is_image(self) -> bool:
+    return self.data_format in ("jpeg", "png")
+
+  def replace(self, **overrides) -> "ExtendedTensorSpec":
+    return self.from_spec(self, **overrides)
+
+  def __repr__(self):
+    parts = [f"shape={self.shape}", f"dtype={np.dtype(self.dtype).name}"]
+    if self.name:
+      parts.append(f"name={self.name!r}")
+    for attr in ("is_optional", "is_sequence", "varlen"):
+      if getattr(self, attr):
+        parts.append(f"{attr}=True")
+    if self.data_format:
+      parts.append(f"data_format={self.data_format!r}")
+    if self.dataset_key:
+      parts.append(f"dataset_key={self.dataset_key!r}")
+    return f"ExtendedTensorSpec({', '.join(parts)})"
+
+
+# Short alias used throughout the codebase.
+TensorSpec = ExtendedTensorSpec
+
+
+class TensorSpecStruct(Mapping[str, Any]):
+  """An ordered, nested attribute/dict hybrid container for specs & tensors.
+
+  Reference parity: tensor2robot's `TensorSpecStruct` (utils/
+  tensorspec_utils.py [U]) — an OrderedDict subclass allowing both
+  `struct.key` attribute access and `struct['a/b']` flat path access over
+  a nested structure.
+
+  TPU-native twist: instances are registered as a JAX pytree node
+  (see `register_pytree_node` below), so the same container type holds
+  spec trees, `ShapeDtypeStruct` trees, and concrete batch trees, and can
+  be passed straight into jitted/pjitted functions.
+
+  Internally the structure is stored FLAT: an insertion-ordered dict from
+  '/'-joined paths to leaves. Nested access materializes sub-structs
+  lazily. This makes flatten/pack trivial and guarantees a stable leaf
+  order for pytree flattening (insertion order, like the reference's
+  OrderedDict semantics).
+  """
+
+  __slots__ = ("_flat",)
+
+  def __init__(self, *args, **kwargs):
+    object.__setattr__(self, "_flat", {})
+    init = {}
+    if args:
+      if len(args) > 1:
+        raise TypeError("TensorSpecStruct takes at most one positional arg")
+      src = args[0]
+      if isinstance(src, TensorSpecStruct):
+        init.update(src._flat)
+      elif isinstance(src, Mapping):
+        init.update(src)
+      else:
+        init.update(dict(src))
+    init.update(kwargs)
+    for key, value in init.items():
+      self[key] = value
+
+  # ---- core mapping protocol over flat '/' paths and nested prefixes ----
+
+  def _subkeys(self, prefix: str):
+    prefix_sep = prefix + PATH_SEP
+    return [k for k in self._flat if k.startswith(prefix_sep)]
+
+  def __getitem__(self, key: str):
+    if not isinstance(key, str):
+      raise TypeError(f"Keys must be str, got {type(key)}")
+    if key in self._flat:
+      return self._flat[key]
+    sub = self._subkeys(key)
+    if sub:
+      prefix_sep = key + PATH_SEP
+      return TensorSpecStruct(
+          {k[len(prefix_sep):]: self._flat[k] for k in sub})
+    raise KeyError(key)
+
+  def __setitem__(self, key: str, value: Any):
+    if not isinstance(key, str) or not key or key.startswith(PATH_SEP):
+      raise KeyError(f"Invalid key: {key!r}")
+    if isinstance(value, (TensorSpecStruct, dict)):
+      items = (value._flat.items() if isinstance(value, TensorSpecStruct)
+               else TensorSpecStruct(value)._flat.items())
+      # Clear any existing leaf/subtree at this key first.
+      self._delete_prefix(key, missing_ok=True)
+      for sub_key, leaf in items:
+        self._flat[f"{key}{PATH_SEP}{sub_key}"] = leaf
+    else:
+      # A leaf overwrite shadows any subtree previously at this path.
+      self._delete_prefix(key, missing_ok=True)
+      self._flat[key] = value
+
+  def _delete_prefix(self, key: str, missing_ok: bool = False):
+    found = False
+    if key in self._flat:
+      del self._flat[key]
+      found = True
+    for k in self._subkeys(key):
+      del self._flat[k]
+      found = True
+    if not found and not missing_ok:
+      raise KeyError(key)
+
+  def __delitem__(self, key: str):
+    self._delete_prefix(key)
+
+  def __contains__(self, key) -> bool:
+    return key in self._flat or bool(self._subkeys(key))
+
+  def __iter__(self) -> Iterator[str]:
+    # Iterates top-level keys, preserving first-insertion order.
+    seen = []
+    for k in self._flat:
+      top = k.split(PATH_SEP, 1)[0]
+      if top not in seen:
+        seen.append(top)
+    return iter(seen)
+
+  def __len__(self) -> int:
+    return sum(1 for _ in self)
+
+  # ---- attribute access ----
+
+  def __getattr__(self, name: str):
+    if name.startswith("_"):
+      raise AttributeError(name)
+    try:
+      return self[name]
+    except KeyError as e:
+      raise AttributeError(name) from e
+
+  def __setattr__(self, name: str, value: Any):
+    if name.startswith("_"):
+      object.__setattr__(self, name, value)
+    else:
+      self[name] = value
+
+  def __delattr__(self, name: str):
+    try:
+      del self[name]
+    except KeyError as e:
+      raise AttributeError(name) from e
+
+  # ---- flat views ----
+
+  def to_flat_dict(self) -> dict:
+    """Flat '/'-path → leaf dict (insertion-ordered copy)."""
+    return dict(self._flat)
+
+  @classmethod
+  def from_flat_dict(cls, flat: Mapping[str, Any]) -> "TensorSpecStruct":
+    out = cls()
+    for k, v in flat.items():
+      out._flat[k] = v
+    return out
+
+  def to_nested_dict(self) -> dict:
+    out: dict = {}
+    for path, leaf in self._flat.items():
+      parts = path.split(PATH_SEP)
+      node = out
+      for p in parts[:-1]:
+        node = node.setdefault(p, {})
+      node[parts[-1]] = leaf
+    return out
+
+  # ---- niceties ----
+
+  def keys(self):
+    return list(iter(self))
+
+  def values(self):
+    return [self[k] for k in self]
+
+  def items(self):
+    return [(k, self[k]) for k in self]
+
+  def __eq__(self, other):
+    if isinstance(other, TensorSpecStruct):
+      return self._flat == other._flat
+    if isinstance(other, Mapping):
+      try:
+        return self._flat == TensorSpecStruct(other)._flat
+      except (KeyError, TypeError):
+        return False
+    return NotImplemented
+
+  def __repr__(self):
+    inner = ", ".join(f"{k}: {v!r}" for k, v in self._flat.items())
+    return f"TensorSpecStruct({{{inner}}})"
+
+
+def _struct_flatten(struct: TensorSpecStruct):
+  flat = struct.to_flat_dict()
+  keys = tuple(flat.keys())
+  return tuple(flat.values()), keys
+
+
+def _struct_unflatten(keys, leaves):
+  out = TensorSpecStruct()
+  for k, v in zip(keys, leaves):
+    out._flat[k] = v  # bypass subtree logic: keys are known-flat
+  return out
+
+
+jax.tree_util.register_pytree_node(
+    TensorSpecStruct, _struct_flatten, _struct_unflatten)
+
+
+SpecOrStruct = Union[ExtendedTensorSpec, TensorSpecStruct, Mapping]

@@ -1,0 +1,18 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Multi-chip sharding is tested without TPU hardware by splitting the host
+CPU into 8 virtual XLA devices (SURVEY.md §5 lesson: add the multi-chip
+tests the reference lacked). Must run before jax initializes its backends.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+  os.environ["XLA_FLAGS"] = (
+      xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# Keep TF (used only for TFRecord IO / jax2tf export) off any accelerator.
+os.environ.setdefault("CUDA_VISIBLE_DEVICES", "-1")
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")

@@ -1,0 +1,309 @@
+"""Tests for the spec system (parity with utils/tensorspec_utils_test.py [U])."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensor2robot_tpu import specs
+from tensor2robot_tpu.specs import ExtendedTensorSpec, TensorSpecStruct
+
+
+class TestExtendedTensorSpec:
+
+  def test_basic_construction(self):
+    s = ExtendedTensorSpec(shape=(64, 64, 3), dtype=np.uint8, name="img")
+    assert s.shape == (64, 64, 3)
+    assert s.dtype == np.dtype(np.uint8)
+    assert s.name == "img"
+    assert not s.is_optional and not s.is_sequence and not s.varlen
+
+  def test_bfloat16(self):
+    s = ExtendedTensorSpec(shape=(8,), dtype="bfloat16")
+    assert s.dtype == jnp.bfloat16.dtype
+    sds = s.to_shape_dtype_struct(batch_size=4)
+    assert sds.shape == (4, 8)
+    assert sds.dtype == jnp.bfloat16
+
+  def test_rejects_undefined_shape(self):
+    with pytest.raises(ValueError):
+      ExtendedTensorSpec(shape=(-1, 3), dtype=np.float32)
+
+  def test_rejects_bad_data_format(self):
+    with pytest.raises(ValueError):
+      ExtendedTensorSpec(shape=(2,), dtype=np.uint8, data_format="bmp")
+
+  def test_from_spec_overrides(self):
+    s = ExtendedTensorSpec(shape=(3,), dtype=np.float32, name="a")
+    t = ExtendedTensorSpec.from_spec(s, name="b", is_optional=True)
+    assert t.shape == s.shape and t.dtype == s.dtype
+    assert t.name == "b" and t.is_optional
+
+  def test_from_array(self):
+    arr = np.zeros((5, 2), np.int32)
+    s = ExtendedTensorSpec.from_array(arr, name="x")
+    assert s.shape == (5, 2) and s.dtype == np.dtype(np.int32)
+
+  def test_sequence_shape_dtype_struct(self):
+    s = ExtendedTensorSpec(shape=(7,), dtype=np.float32, is_sequence=True)
+    sds = s.to_shape_dtype_struct(batch_size=2, sequence_length=5)
+    assert sds.shape == (2, 5, 7)
+
+  def test_hashable_and_frozen(self):
+    s = ExtendedTensorSpec(shape=(3,), dtype=np.float32)
+    assert hash(s) == hash(ExtendedTensorSpec(shape=(3,), dtype=np.float32))
+    with pytest.raises(Exception):
+      s.shape = (4,)  # frozen dataclass
+
+
+class TestTensorSpecStruct:
+
+  def make(self):
+    st = TensorSpecStruct()
+    st.image = ExtendedTensorSpec(shape=(32, 32, 3), dtype=np.uint8,
+                                  name="image", data_format="jpeg")
+    st.pose = ExtendedTensorSpec(shape=(6,), dtype=np.float32, name="pose")
+    return st
+
+  def test_attribute_and_item_access(self):
+    st = self.make()
+    assert st.image is st["image"]
+    assert list(st.keys()) == ["image", "pose"]
+
+  def test_nested_path_access(self):
+    st = TensorSpecStruct()
+    st["a/b/c"] = ExtendedTensorSpec(shape=(1,), dtype=np.float32)
+    sub = st.a
+    assert isinstance(sub, TensorSpecStruct)
+    assert "b/c" in sub.to_flat_dict()
+    assert st["a/b"]["c"].shape == (1,)
+
+  def test_nested_assignment_of_struct(self):
+    st = TensorSpecStruct()
+    inner = TensorSpecStruct()
+    inner.x = ExtendedTensorSpec(shape=(2,), dtype=np.float32)
+    st.sub = inner
+    assert st["sub/x"].shape == (2,)
+    assert isinstance(st.sub, TensorSpecStruct)
+
+  def test_dict_init_nested(self):
+    st = TensorSpecStruct({
+        "obs": {"img": ExtendedTensorSpec(shape=(4,), dtype=np.float32)},
+        "act": ExtendedTensorSpec(shape=(2,), dtype=np.float32),
+    })
+    assert st["obs/img"].shape == (4,)
+    assert st.act.shape == (2,)
+
+  def test_insertion_order_preserved(self):
+    st = TensorSpecStruct()
+    for name in ["z", "a", "m"]:
+      st[name] = ExtendedTensorSpec(shape=(1,), dtype=np.float32)
+    assert st.keys() == ["z", "a", "m"]
+
+  def test_delete(self):
+    st = self.make()
+    del st.image
+    assert "image" not in st
+    with pytest.raises(AttributeError):
+      _ = st.image
+
+  def test_leaf_overwrites_subtree(self):
+    st = TensorSpecStruct()
+    st["a/b"] = ExtendedTensorSpec(shape=(1,), dtype=np.float32)
+    st["a"] = ExtendedTensorSpec(shape=(2,), dtype=np.float32)
+    assert st.a.shape == (2,)
+    assert "a/b" not in st
+
+  def test_pytree_roundtrip(self):
+    st = self.make()
+    leaves, treedef = jax.tree_util.tree_flatten(st)
+    assert len(leaves) == 2
+    back = jax.tree_util.tree_unflatten(treedef, leaves)
+    assert back == st
+
+  def test_jit_through_struct(self):
+    # A TensorSpecStruct of arrays can pass through jit directly.
+    batch = TensorSpecStruct()
+    batch.x = jnp.ones((4, 3))
+    batch.y = jnp.ones((4,))
+
+    @jax.jit
+    def f(b):
+      out = TensorSpecStruct()
+      out.z = b.x.sum(axis=-1) + b.y
+      return out
+
+    out = f(batch)
+    assert out.z.shape == (4,)
+    np.testing.assert_allclose(np.asarray(out.z), 4.0 * np.ones((4,)))
+
+  def test_equality_with_mapping(self):
+    st = TensorSpecStruct()
+    st.x = 1
+    assert st == {"x": 1}
+
+
+class TestPacking:
+
+  def specs2(self):
+    st = TensorSpecStruct()
+    st.image = ExtendedTensorSpec(shape=(8, 8, 3), dtype=np.float32,
+                                  name="image")
+    st.action = ExtendedTensorSpec(shape=(4,), dtype=np.float32,
+                                   name="action")
+    st.aux = ExtendedTensorSpec(shape=(2,), dtype=np.float32, name="aux",
+                                is_optional=True)
+    return st
+
+  def test_flatten_nested_mixture(self):
+    flat = specs.flatten_spec_structure({
+        "a": [ExtendedTensorSpec(shape=(1,), dtype=np.float32),
+              ExtendedTensorSpec(shape=(2,), dtype=np.float32)],
+        "b": {"c": ExtendedTensorSpec(shape=(3,), dtype=np.float32)},
+    })
+    assert set(flat.to_flat_dict()) == {"a/0", "a/1", "b/c"}
+
+  def test_validate_and_pack_ok(self):
+    st = self.specs2()
+    data = {
+        "image": np.zeros((2, 8, 8, 3), np.float32),
+        "action": np.zeros((2, 4), np.float32),
+    }
+    packed = specs.validate_and_pack(st, data, ignore_batch=True)
+    assert set(packed.keys()) == {"image", "action"}
+
+  def test_optional_present_is_kept(self):
+    st = self.specs2()
+    data = {
+        "image": np.zeros((2, 8, 8, 3), np.float32),
+        "action": np.zeros((2, 4), np.float32),
+        "aux": np.zeros((2, 2), np.float32),
+    }
+    packed = specs.validate_and_pack(st, data)
+    assert "aux" in packed
+
+  def test_missing_required_raises(self):
+    st = self.specs2()
+    with pytest.raises(specs.SpecValidationError, match="action"):
+      specs.validate_and_pack(st, {
+          "image": np.zeros((2, 8, 8, 3), np.float32)})
+
+  def test_shape_mismatch_raises(self):
+    st = self.specs2()
+    with pytest.raises(specs.SpecValidationError, match="shape"):
+      specs.validate_and_pack(st, {
+          "image": np.zeros((2, 8, 8, 3), np.float32),
+          "action": np.zeros((2, 5), np.float32)})
+
+  def test_dtype_mismatch_raises(self):
+    st = self.specs2()
+    with pytest.raises(specs.SpecValidationError, match="dtype"):
+      specs.validate_and_pack(st, {
+          "image": np.zeros((2, 8, 8, 3), np.float32),
+          "action": np.zeros((2, 4), np.int32)})
+
+  def test_extra_tensors_dropped(self):
+    st = self.specs2()
+    packed = specs.validate_and_pack(st, {
+        "image": np.zeros((2, 8, 8, 3), np.float32),
+        "action": np.zeros((2, 4), np.float32),
+        "junk": np.zeros((2, 1), np.float32)})
+    assert "junk" not in packed
+
+  def test_filter_required(self):
+    st = self.specs2()
+    req = specs.filter_required_flat_tensor_spec_structure(st)
+    assert set(req.to_flat_dict()) == {"image", "action"}
+
+  def test_pack_flat_sequence(self):
+    st = self.specs2()
+    leaves = [np.zeros(s.shape, s.dtype)
+              for s in specs.flatten_spec_structure(st).values()]
+    packed = specs.pack_flat_sequence_to_spec_structure(st, leaves)
+    assert packed.keys() == ["image", "action", "aux"]
+
+  def test_replace_dtype(self):
+    st = self.specs2()
+    out = specs.replace_dtype(st, np.float32, jnp.bfloat16)
+    assert out["image"].dtype == jnp.bfloat16.dtype
+
+  def test_sequence_validation(self):
+    st = TensorSpecStruct()
+    st.obs = ExtendedTensorSpec(shape=(3,), dtype=np.float32,
+                                is_sequence=True)
+    ok = np.zeros((2, 5, 3), np.float32)  # batch, time, features
+    specs.validate_and_pack(st, {"obs": ok})
+    with pytest.raises(specs.SpecValidationError):
+      specs.validate_and_pack(st, {"obs": np.zeros((2, 3), np.float32)})
+
+  def test_add_sequence_length(self):
+    st = TensorSpecStruct()
+    st.obs = ExtendedTensorSpec(shape=(3,), dtype=np.float32,
+                                is_sequence=True)
+    out = specs.add_sequence_length(st, 5)
+    assert out.obs.shape == (5, 3) and not out.obs.is_sequence
+
+
+class TestSerialization:
+
+  def test_roundtrip(self):
+    st = TensorSpecStruct()
+    st.image = ExtendedTensorSpec(shape=(16, 16, 3), dtype=np.uint8,
+                                  name="image", data_format="jpeg")
+    st["nested/pose"] = ExtendedTensorSpec(
+        shape=(6,), dtype="bfloat16", is_optional=True, varlen=False)
+    labels = TensorSpecStruct()
+    labels.target = ExtendedTensorSpec(shape=(2,), dtype=np.float32,
+                                       is_sequence=True)
+    ser = specs.serialize_assets(st, label_spec=labels, global_step=42)
+    out = specs.deserialize_assets(ser)
+    assert out["feature_spec"]["image"] == st.image
+    assert out["feature_spec"]["nested/pose"] == st["nested/pose"]
+    assert out["label_spec"]["target"] == labels.target
+    assert out["global_step"] == 42
+
+  def test_file_roundtrip(self, tmp_path):
+    st = TensorSpecStruct()
+    st.x = ExtendedTensorSpec(shape=(3,), dtype=np.float32, name="x")
+    path = str(tmp_path / "t2r_assets.json")
+    specs.write_assets(path, st)
+    out = specs.read_assets(path)
+    assert out["feature_spec"]["x"] == st.x
+
+
+class TestRandomData:
+
+  def test_conforms_to_specs(self):
+    st = TensorSpecStruct()
+    st.image = ExtendedTensorSpec(shape=(8, 8, 3), dtype=np.uint8,
+                                  name="image")
+    st.pose = ExtendedTensorSpec(shape=(6,), dtype=np.float32)
+    st.idx = ExtendedTensorSpec(shape=(1,), dtype=np.int64)
+    batch = specs.make_random_tensors(st, batch_size=4, seed=1)
+    packed = specs.validate_and_pack(st, batch)
+    assert packed["image"].shape == (4, 8, 8, 3)
+    assert packed["pose"].dtype == np.float32
+
+  def test_deterministic(self):
+    st = TensorSpecStruct()
+    st.x = ExtendedTensorSpec(shape=(5,), dtype=np.float32)
+    a = specs.make_random_tensors(st, batch_size=2, seed=7)
+    b = specs.make_random_tensors(st, batch_size=2, seed=7)
+    np.testing.assert_array_equal(a["x"], b["x"])
+
+  def test_sequence_and_optional(self):
+    st = TensorSpecStruct()
+    st.obs = ExtendedTensorSpec(shape=(3,), dtype=np.float32,
+                                is_sequence=True)
+    st.extra = ExtendedTensorSpec(shape=(1,), dtype=np.float32,
+                                  is_optional=True)
+    batch = specs.make_random_tensors(
+        st, batch_size=2, sequence_length=6, include_optional=False)
+    assert batch["obs"].shape == (2, 6, 3)
+    assert "extra" not in batch
+
+  def test_bfloat16_generation(self):
+    st = TensorSpecStruct()
+    st.x = ExtendedTensorSpec(shape=(4,), dtype="bfloat16")
+    batch = specs.make_random_tensors(st, batch_size=2)
+    assert batch["x"].dtype == jnp.bfloat16.dtype
