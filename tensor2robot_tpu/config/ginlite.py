@@ -177,25 +177,34 @@ class _Configurable:
     return params, has_kwargs
 
   def gather_bindings(self, scope_stack: Sequence[str]) -> Dict[str, Any]:
-    """Merges unscoped then progressively-scoped bindings (inner wins)."""
+    """Merges bindings in gin specificity order (most specific last).
+
+    Candidates are every contiguous subsequence of the active scope
+    stack (plus unscoped), ordered by (innermost end position, match
+    length): a binding scoped deeper in the stack beats one scoped
+    shallower; at the same depth a longer compound scope (`a/b`) beats
+    a shorter one (`b`).
+    """
+    candidates = [("", 0, 0)]
+    for j in range(len(scope_stack)):
+      for i in range(j + 1):
+        scope = "/".join(scope_stack[i:j + 1])
+        candidates.append((scope, j + 1, j + 1 - i))
+    candidates.sort(key=lambda t: (t[1], t[2]))
     merged: Dict[str, Any] = {}
     with _REGISTRY.lock:
-      for key in [("", self.name), ("", self.full_name)]:
-        merged.update(_REGISTRY.bindings.get(key, {}))
-      # Apply each active scope, outermost to innermost, then compound
-      # scopes like 'a/b'.
-      for i in range(len(scope_stack)):
-        for j in range(i, len(scope_stack)):
-          scope = "/".join(scope_stack[i:j + 1])
-          for key in [(scope, self.name), (scope, self.full_name)]:
-            merged.update(_REGISTRY.bindings.get(key, {}))
+      for scope, _, _ in candidates:
+        for key in [(scope, self.name), (scope, self.full_name)]:
+          merged.update(_REGISTRY.bindings.get(key, {}))
     return merged
 
   def _make_wrapper(self) -> Callable:
     configurable = self
 
     if inspect.isclass(self.fn):
-      # Subclass-preserving wrapper: inject into __init__.
+      # Injection lives in a SUBCLASS so the original class is never
+      # mutated: direct instantiation of the original (e.g. after
+      # external_configurable) bypasses gin entirely, matching gin.
       orig_init = self.fn.__init__
 
       @functools.wraps(orig_init)
@@ -203,8 +212,12 @@ class _Configurable:
         merged = configurable._inject(args, kwargs)
         orig_init(obj, *args, **merged)
 
-      wrapped_cls = self.fn
-      wrapped_cls.__init__ = wrapped_init
+      wrapped_cls = type(self.fn.__name__, (self.fn,), {
+          "__init__": wrapped_init,
+          "__module__": self.fn.__module__,
+          "__qualname__": self.fn.__qualname__,
+          "__doc__": self.fn.__doc__,
+      })
       return wrapped_cls
 
     @functools.wraps(self.fn)
@@ -305,11 +318,16 @@ def _lookup_configurable(name: str) -> Optional[_Configurable]:
   with _REGISTRY.lock:
     if name in _REGISTRY.configurables:
       return _REGISTRY.configurables[name]
-    # Allow partial module-qualified lookups: match unique suffix.
-    matches = {c for n, c in _REGISTRY.configurables.items()
-               if n.endswith("." + name)}
+    # Partial module qualification, both directions: a registered
+    # 'module.fn' matches queries 'fn' and 'pkg.module.fn'.
+    matches = {id(c): c for n, c in _REGISTRY.configurables.items()
+               if n.endswith("." + name) or name.endswith("." + n)}
     if len(matches) == 1:
-      return matches.pop()
+      return next(iter(matches.values()))
+    if len(matches) > 1:
+      raise GinError(
+          f"Ambiguous configurable name {name!r}; candidates: "
+          f"{sorted(c.full_name for c in matches.values())}")
   return None
 
 
@@ -409,15 +427,30 @@ def parse_value(text: str) -> Any:
   return _restore_placeholders(value, placeholders)
 
 
+def _canonical_name(name: str, skip_unknown: bool = False) -> Optional[str]:
+  """Resolves a binding target to its registered bare name, or raises."""
+  cfg = _lookup_configurable(name)
+  if cfg is None:
+    if skip_unknown:
+      return None
+    raise GinError(
+        f"No configurable matching {name!r} is registered. Import the "
+        f"defining module first (configs may use 'import a.b.c' lines), "
+        f"or parse with skip_unknown=True.")
+  return cfg.name
+
+
 def bind_parameter(binding_name: str, value: Any) -> None:
   """Binds `scope/configurable.param` to an (already-python) value."""
   scope, name, param = _split_binding_name(binding_name)
+  name = _canonical_name(name)
   with _REGISTRY.lock:
     _REGISTRY.bindings.setdefault((scope, name), {})[param] = value
 
 
 def query_parameter(binding_name: str) -> Any:
   scope, name, param = _split_binding_name(binding_name)
+  name = _canonical_name(name)
   with _REGISTRY.lock:
     try:
       return _REGISTRY.bindings[(scope, name)][param]
@@ -508,9 +541,10 @@ def _parse_statement(stmt: str, skip_unknown: bool = False) -> None:
       _REGISTRY.macros[target] = value
     return
   name, _, param = rest.rpartition(".")
-  if not skip_unknown or _lookup_configurable(name) is not None:
+  canonical = _canonical_name(name, skip_unknown=skip_unknown)
+  if canonical is not None:
     with _REGISTRY.lock:
-      _REGISTRY.bindings.setdefault((scope, name), {})[param] = value
+      _REGISTRY.bindings.setdefault((scope, canonical), {})[param] = value
 
 
 _SEARCH_PATHS: List[str] = [""]

@@ -1,0 +1,21 @@
+"""Spec-driven input pipelines (reference: tensor2robot input_generators/)."""
+
+from tensor2robot_tpu.data.abstract_input_generator import (
+    AbstractInputGenerator,
+    Mode,
+)
+from tensor2robot_tpu.data.random_input_generator import (
+    DefaultRandomInputGenerator,
+    RandomInputGenerator,
+)
+from tensor2robot_tpu.data.tfrecord_input_generator import (
+    DefaultRecordInputGenerator,
+    TFRecordInputGenerator,
+    write_tfrecord,
+)
+from tensor2robot_tpu.data.prefetch import (
+    ShardedPrefetcher,
+    device_put_batch,
+    make_data_sharding,
+    prefetch_to_mesh,
+)

@@ -1,0 +1,109 @@
+"""Abstract input generator: model specs → batched host data streams.
+
+Reference parity: tensor2robot `input_generators/abstract_input_generator.py`
+(`AbstractInputGenerator.create_dataset_input_fn`,
+`set_specification_from_model`; file:line unavailable — see SURVEY.md).
+
+TPU-native redesign: instead of returning a TF `input_fn` for an
+Estimator, a generator yields an infinite stream of spec-conforming
+*numpy* batches on the host; the trainer wraps the stream with
+`data.prefetch.ShardedPrefetcher`, which places each batch onto the
+device mesh (sharded along the data axis) one step ahead of compute.
+The host side stays pure numpy/tf.data — no python in the jitted hot
+loop — matching the reference's host-side parse / device-side compute
+split (SURVEY.md §4.3).
+"""
+
+from __future__ import annotations
+
+import abc
+import enum
+from typing import Any, Dict, Iterator, Optional, Tuple
+
+from tensor2robot_tpu import specs
+from tensor2robot_tpu.specs import TensorSpecStruct
+
+
+class Mode(str, enum.Enum):
+  """Train/eval/predict modes (reference: tf.estimator.ModeKeys)."""
+
+  TRAIN = "train"
+  EVAL = "eval"
+  PREDICT = "predict"
+
+
+class AbstractInputGenerator(abc.ABC):
+  """Produces spec-conforming batches for a model.
+
+  Lifecycle (mirrors the reference):
+    1. `set_specification_from_model(model, mode)` copies the model's
+       *wire-side* (preprocessor-in) feature/label specs into the
+       generator.
+    2. `create_dataset(mode, batch_size)` returns an iterator of
+       `(features, labels)` TensorSpecStructs of numpy arrays.
+  """
+
+  def __init__(self, batch_size: int = 32):
+    self._batch_size = batch_size
+    self._feature_spec: Optional[TensorSpecStruct] = None
+    self._label_spec: Optional[TensorSpecStruct] = None
+
+  @property
+  def batch_size(self) -> int:
+    return self._batch_size
+
+  @batch_size.setter
+  def batch_size(self, value: int):
+    self._batch_size = int(value)
+
+  @property
+  def feature_spec(self) -> TensorSpecStruct:
+    if self._feature_spec is None:
+      raise ValueError(
+          "Input generator has no specs; call "
+          "set_specification_from_model(model, mode) first.")
+    return self._feature_spec
+
+  @property
+  def label_spec(self) -> Optional[TensorSpecStruct]:
+    return self._label_spec
+
+  def set_specification_from_model(self, model, mode: Mode) -> None:
+    """Adopts the model's preprocessor-in (wire) specs."""
+    preprocessor = getattr(model, "preprocessor", None)
+    if preprocessor is not None:
+      self.set_specification(
+          preprocessor.get_in_feature_specification(mode),
+          preprocessor.get_in_label_specification(mode))
+    else:
+      self.set_specification(
+          model.get_feature_specification(mode),
+          model.get_label_specification(mode))
+
+  def set_specification(
+      self, feature_spec: Any, label_spec: Optional[Any] = None) -> None:
+    self._feature_spec = specs.flatten_spec_structure(feature_spec)
+    specs.assert_valid_spec_structure(self._feature_spec)
+    if label_spec is not None:
+      self._label_spec = specs.flatten_spec_structure(label_spec)
+      specs.assert_valid_spec_structure(self._label_spec)
+
+  def create_dataset(
+      self, mode: Mode, batch_size: Optional[int] = None,
+  ) -> Iterator[Tuple[TensorSpecStruct, Optional[TensorSpecStruct]]]:
+    """Returns an iterator of (features, labels) numpy batches."""
+    if self._feature_spec is None:
+      raise ValueError(
+          "set_specification_from_model must be called before "
+          "create_dataset.")
+    return self._create_dataset(mode, batch_size or self._batch_size)
+
+  # Reference-compatible alias.
+  def create_dataset_input_fn(self, mode: Mode, **kwargs):
+    return lambda: self.create_dataset(mode, **kwargs)
+
+  @abc.abstractmethod
+  def _create_dataset(
+      self, mode: Mode, batch_size: int,
+  ) -> Iterator[Tuple[TensorSpecStruct, Optional[TensorSpecStruct]]]:
+    ...

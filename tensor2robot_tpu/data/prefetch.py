@@ -1,0 +1,130 @@
+"""Host→device pipelining: sharded, double-buffered batch placement.
+
+This replaces the reference's TPUEstimator infeed queue (SURVEY.md §4.1
+"host↔device boundary is the infeed queue fed by tf.data"). TPU-native
+version: each host batch is placed onto the mesh as a global `jax.Array`
+sharded along the data axis via `jax.make_array_from_process_local_data`
+(multi-host correct: each process contributes its local shard), with a
+lookahead buffer so device compute of step N overlaps host prep + H2D
+transfer of step N+1.
+"""
+
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+from typing import Any, Iterator, Optional
+
+import jax
+import numpy as np
+
+from tensor2robot_tpu.specs import TensorSpecStruct
+
+
+def make_data_sharding(mesh: jax.sharding.Mesh,
+                       data_axes=("data",)) -> jax.sharding.NamedSharding:
+  """Batch-dim sharding over the mesh's data axes, replicated elsewhere."""
+  axes = tuple(a for a in data_axes if a in mesh.axis_names)
+  spec = jax.sharding.PartitionSpec(axes if axes else None)
+  return jax.sharding.NamedSharding(mesh, spec)
+
+
+def device_put_batch(batch: Any, sharding: jax.sharding.Sharding) -> Any:
+  """Places a pytree of host numpy arrays as global sharded jax.Arrays."""
+
+  def put(x):
+    x = np.asarray(x)
+    # Batch-axis sharding only applies to arrays with a batch dim; scalars
+    # replicate.
+    if x.ndim == 0:
+      return jax.device_put(x)
+    return jax.make_array_from_process_local_data(sharding, x)
+
+  return jax.tree_util.tree_map(put, batch)
+
+
+class ShardedPrefetcher:
+  """Iterator wrapper: host batches → mesh-sharded arrays, N steps ahead.
+
+  A background thread pulls from the (possibly slow: TFRecord parse,
+  image decode) host iterator and performs the H2D transfer, keeping up
+  to `buffer_size` global batches resident ahead of compute. This is the
+  framework's single host↔device seam; everything downstream is jitted.
+  """
+
+  def __init__(self,
+               iterator: Iterator[Any],
+               sharding: jax.sharding.Sharding,
+               buffer_size: int = 2):
+    self._iterator = iterator
+    self._sharding = sharding
+    self._buffer_size = buffer_size
+    self._queue: "queue.Queue" = queue.Queue(maxsize=buffer_size)
+    self._done = object()
+    self._error: Optional[BaseException] = None
+    self._stop = threading.Event()
+    self._thread = threading.Thread(target=self._worker, daemon=True)
+    self._thread.start()
+
+  def _worker(self):
+    try:
+      for batch in self._iterator:
+        placed = device_put_batch(batch, self._sharding)
+        # Bounded put that notices close(): don't block forever holding
+        # device buffers once the consumer abandoned the stream.
+        while not self._stop.is_set():
+          try:
+            self._queue.put(placed, timeout=0.1)
+            break
+          except queue.Full:
+            continue
+        if self._stop.is_set():
+          return
+    except BaseException as e:  # surfaced on the consumer thread
+      self._error = e
+    finally:
+      # The sentinel must reach the consumer (or close() must have been
+      # called) or __next__ would block forever; bounded-put like above.
+      while not self._stop.is_set():
+        try:
+          self._queue.put(self._done, timeout=0.1)
+          break
+        except queue.Full:
+          continue
+
+  def close(self) -> None:
+    """Stops the worker and releases buffered device batches.
+
+    Call when abandoning the stream early (e.g. bounded eval over an
+    infinite generator); otherwise the worker thread would sit blocked
+    holding `buffer_size` device-resident batches.
+    """
+    self._stop.set()
+    while True:
+      try:
+        self._queue.get_nowait()
+      except queue.Empty:
+        break
+    self._thread.join(timeout=5.0)
+
+  def __iter__(self):
+    return self
+
+  def __next__(self):
+    if self._stop.is_set():
+      raise StopIteration
+    item = self._queue.get()
+    if item is self._done:
+      if self._error is not None:
+        raise self._error
+      raise StopIteration
+    return item
+
+
+def prefetch_to_mesh(iterator: Iterator[Any],
+                     mesh: jax.sharding.Mesh,
+                     data_axes=("data",),
+                     buffer_size: int = 2) -> ShardedPrefetcher:
+  return ShardedPrefetcher(
+      iterator, make_data_sharding(mesh, data_axes), buffer_size)

@@ -1,0 +1,3 @@
+"""Trainer hooks (reference: tensor2robot hooks/ SessionRunHook builders)."""
+
+from tensor2robot_tpu.hooks.hook import Hook, HookList

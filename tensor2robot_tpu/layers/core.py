@@ -1,0 +1,62 @@
+"""Core network building blocks shared by the canonical models.
+
+TPU-first conventions: bfloat16-friendly (dtype parameter everywhere,
+params stay float32), channel counts that tile the 128×128 MXU, and no
+python control flow on traced values.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tensor2robot_tpu.specs import TensorSpecStruct
+
+
+def flatten_and_concat(features: Any,
+                       keys: Optional[Sequence[str]] = None) -> jax.Array:
+  """Flattens selected (or all floating) leaves and concats on last axis."""
+  if isinstance(features, (dict, TensorSpecStruct)):
+    flat = features.to_flat_dict() if isinstance(features, TensorSpecStruct) \
+        else dict(features)
+    if keys is not None:
+      leaves = [flat[k] for k in keys]
+    else:
+      leaves = [v for v in flat.values()
+                if jnp.issubdtype(v.dtype, jnp.floating)]
+  else:
+    leaves = [features]
+  batch = leaves[0].shape[0]
+  return jnp.concatenate(
+      [leaf.reshape(batch, -1) for leaf in leaves], axis=-1)
+
+
+class MLP(nn.Module):
+  """Plain MLP; optionally applies to a feature struct via key selection."""
+
+  hidden_sizes: Sequence[int]
+  output_size: Optional[int] = None
+  activation: Callable = nn.relu
+  dropout_rate: float = 0.0
+  activate_final: bool = False
+  feature_keys: Optional[Sequence[str]] = None
+  dtype: Any = jnp.float32
+
+  @nn.compact
+  def __call__(self, features, train: bool = False):
+    x = flatten_and_concat(features, self.feature_keys)
+    x = x.astype(self.dtype)
+    sizes = list(self.hidden_sizes)
+    if self.output_size is not None:
+      sizes.append(self.output_size)
+    for i, size in enumerate(sizes):
+      x = nn.Dense(size, dtype=self.dtype, name=f"dense_{i}")(x)
+      is_last = i == len(sizes) - 1
+      if not is_last or self.activate_final:
+        x = self.activation(x)
+        if self.dropout_rate > 0:
+          x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+    return x.astype(jnp.float32)

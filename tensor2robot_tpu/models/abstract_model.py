@@ -1,0 +1,256 @@
+"""AbstractT2RModel: the model_fn template, redesigned as pure JAX steps.
+
+Reference parity: tensor2robot `models/abstract_model.py` —
+`AbstractT2RModel.model_fn` with its preprocess → `inference_network_fn`
+→ train/eval/predict branches, optimizer creation, and checkpoint
+warm-start (`maybe_init_from_checkpoint`); SURVEY.md §4.2.
+
+TPU-native redesign: instead of one `model_fn(features, labels, mode)`
+building a TF graph per mode, the model exposes three PURE functions —
+`train_step`, `eval_step`, `predict_step` — each of which traces
+preprocess + network + loss into a single XLA program. The trainer jits
+them over a device mesh (batch sharded on the data axis, params
+replicated or sharded by the model's partitioning rules); GSPMD inserts
+the gradient all-reduce the reference got from CrossShardOptimizer.
+Mutable collections (batch_norm stats) and dropout RNG are threaded
+explicitly, as JAX requires.
+"""
+
+from __future__ import annotations
+
+import abc
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import flax
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from tensor2robot_tpu import config as gin
+from tensor2robot_tpu import specs as specs_lib
+from tensor2robot_tpu.data.abstract_input_generator import Mode
+from tensor2robot_tpu.models import optimizers as opt_lib
+from tensor2robot_tpu.models.model_interface import ModelInterface
+from tensor2robot_tpu.preprocessors.noop_preprocessor import NoOpPreprocessor
+from tensor2robot_tpu.specs import TensorSpecStruct
+
+
+@flax.struct.dataclass
+class TrainState:
+  """Carried training state: step counter, params, mutable stats, opt."""
+
+  step: jax.Array
+  params: Any
+  batch_stats: Any  # empty dict when the network has no BN-style stats
+  opt_state: Any
+
+  @property
+  def variables(self) -> Dict[str, Any]:
+    out = {"params": self.params}
+    if self.batch_stats:
+      out["batch_stats"] = self.batch_stats
+    return out
+
+
+class AbstractT2RModel(ModelInterface):
+  """Base class for all models: specs + flax network + loss.
+
+  Subclasses implement:
+    * `get_feature_specification(mode)` / `get_label_specification(mode)`
+    * `create_network() -> nn.Module` — the module is applied as
+      `module(features_struct, train=<bool>)` and returns an output
+      structure (dict / TensorSpecStruct / array).
+    * `model_train_fn(features, labels, outputs, mode) -> (loss, scalars)`
+  Optionally:
+    * `model_eval_fn(...) -> scalars` (defaults to train_fn's scalars)
+  """
+
+  def __init__(self,
+               preprocessor_cls: Optional[Callable] = None,
+               create_optimizer_fn: Callable = opt_lib.create_optimizer,
+               init_from_checkpoint_path: Optional[str] = None,
+               device_dtype=jnp.float32):
+    """Args:
+      preprocessor_cls: class (or factory) called with the two model spec
+        getter fns; defaults to NoOpPreprocessor.
+      create_optimizer_fn: zero-arg factory returning an
+        optax.GradientTransformation (gin binds its parameters).
+      init_from_checkpoint_path: warm-start checkpoint directory; params
+        present in the checkpoint override fresh initializers
+        (reference: maybe_init_from_checkpoint).
+      device_dtype: compute dtype networks should favor (bfloat16 on TPU).
+    """
+    self._preprocessor_cls = preprocessor_cls
+    self._create_optimizer_fn = create_optimizer_fn
+    self._init_from_checkpoint_path = init_from_checkpoint_path
+    self._device_dtype = device_dtype
+    self._preprocessor = None
+    self._network = None
+    self._tx = None
+
+  # ---- specs ----
+
+  @abc.abstractmethod
+  def get_feature_specification(self, mode: Mode) -> TensorSpecStruct:
+    ...
+
+  @abc.abstractmethod
+  def get_label_specification(
+      self, mode: Mode) -> Optional[TensorSpecStruct]:
+    ...
+
+  @property
+  def device_dtype(self):
+    return self._device_dtype
+
+  @property
+  def preprocessor(self):
+    if self._preprocessor is None:
+      cls = self._preprocessor_cls or NoOpPreprocessor
+      self._preprocessor = cls(self.get_feature_specification,
+                               self.get_label_specification)
+    return self._preprocessor
+
+  # ---- network ----
+
+  @abc.abstractmethod
+  def create_network(self) -> nn.Module:
+    ...
+
+  @property
+  def network(self) -> nn.Module:
+    if self._network is None:
+      self._network = self.create_network()
+    return self._network
+
+  @property
+  def tx(self):
+    if self._tx is None:
+      self._tx = self._create_optimizer_fn()
+    return self._tx
+
+  def inference_network_fn(self,
+                           variables: Dict[str, Any],
+                           features: TensorSpecStruct,
+                           mode: Mode,
+                           rng: Optional[jax.Array] = None) -> Any:
+    """Applies the network; returns (outputs, new_batch_stats)."""
+    train = mode == Mode.TRAIN
+    rngs = {"dropout": rng} if (train and rng is not None) else None
+    has_stats = "batch_stats" in variables
+    if train and has_stats:
+      outputs, updates = self.network.apply(
+          variables, features, train=True, rngs=rngs,
+          mutable=["batch_stats"])
+      return outputs, updates.get("batch_stats", {})
+    outputs = self.network.apply(variables, features, train=train,
+                                 rngs=rngs)
+    return outputs, variables.get("batch_stats", {})
+
+  # ---- losses/metrics ----
+
+  @abc.abstractmethod
+  def model_train_fn(self,
+                     features: TensorSpecStruct,
+                     labels: Optional[TensorSpecStruct],
+                     outputs: Any,
+                     mode: Mode) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Returns (scalar loss, scalar metrics dict)."""
+
+  def model_eval_fn(self,
+                    features: TensorSpecStruct,
+                    labels: Optional[TensorSpecStruct],
+                    outputs: Any) -> Dict[str, jax.Array]:
+    loss, scalars = self.model_train_fn(features, labels, outputs,
+                                        Mode.EVAL)
+    return {"loss": loss, **scalars}
+
+  # ---- state ----
+
+  def create_train_state(self, rng: jax.Array,
+                         batch_size: int = 1) -> TrainState:
+    """Initializes params (+ batch stats + optimizer state) from specs.
+
+    The dummy init batch is derived mechanically from the preprocessor's
+    OUT specs — the spec system seeding initialization the same way it
+    seeds parsers and tests.
+    """
+    out_spec = self.preprocessor.get_out_feature_specification(Mode.TRAIN)
+    dummy = specs_lib.make_random_tensors(
+        out_spec, batch_size=batch_size, seed=0)
+    dummy = jax.tree_util.tree_map(jnp.asarray, dummy)
+    init_rng, dropout_rng = jax.random.split(rng)
+    variables = self.network.init(
+        {"params": init_rng, "dropout": dropout_rng}, dummy, train=False)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    if self._init_from_checkpoint_path:
+      params = self.maybe_init_from_checkpoint(params)
+    state = TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats=batch_stats,
+        opt_state=self.tx.init(params),
+    )
+    return state
+
+  def maybe_init_from_checkpoint(self, params):
+    """Warm-starts params from `init_from_checkpoint_path` (orbax)."""
+    from tensor2robot_tpu.utils import checkpoints as ckpt_lib
+    restored = ckpt_lib.restore_params(
+        self._init_from_checkpoint_path, like=params)
+    return restored
+
+  # ---- steps (pure; the trainer jits these) ----
+
+  def loss_fn(self, params, batch_stats, features, labels, rng,
+              mode: Mode):
+    variables = {"params": params}
+    if batch_stats:
+      variables["batch_stats"] = batch_stats
+    rng_pre, rng_net = (jax.random.split(rng) if rng is not None
+                        else (None, None))
+    features, labels = self.preprocessor.preprocess(
+        features, labels, mode, rng_pre)
+    outputs, new_stats = self.inference_network_fn(
+        variables, features, mode, rng_net)
+    loss, scalars = self.model_train_fn(features, labels, outputs, mode)
+    return loss, (scalars, new_stats)
+
+  def train_step(self, state: TrainState, features, labels,
+                 rng: jax.Array) -> Tuple[TrainState, Dict[str, jax.Array]]:
+    grad_fn = jax.value_and_grad(self.loss_fn, has_aux=True)
+    (loss, (scalars, new_stats)), grads = grad_fn(
+        state.params, state.batch_stats, features, labels, rng, Mode.TRAIN)
+    updates, new_opt_state = self.tx.update(grads, state.opt_state,
+                                            state.params)
+    new_params = optax.apply_updates(state.params, updates)
+    new_state = state.replace(
+        step=state.step + 1,
+        params=new_params,
+        batch_stats=new_stats,
+        opt_state=new_opt_state,
+    )
+    metrics = {"loss": loss,
+               "grad_norm": optax.global_norm(grads),
+               **scalars}
+    return new_state, metrics
+
+  def eval_step(self, state: TrainState, features,
+                labels) -> Dict[str, jax.Array]:
+    variables = state.variables
+    features, labels = self.preprocessor.preprocess(
+        features, labels, Mode.EVAL, None)
+    outputs, _ = self.inference_network_fn(variables, features, Mode.EVAL)
+    return self.model_eval_fn(features, labels, outputs)
+
+  def predict_step(self, state: TrainState, features) -> Any:
+    variables = state.variables
+    features, _ = self.preprocessor.preprocess(
+        features, None, Mode.PREDICT, None)
+    outputs, _ = self.inference_network_fn(variables, features,
+                                           Mode.PREDICT)
+    return outputs

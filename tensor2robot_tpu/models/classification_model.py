@@ -1,0 +1,68 @@
+"""Classification model base (reference: models/classification_model.py)."""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+from tensor2robot_tpu import config as gin
+from tensor2robot_tpu.layers.core import MLP
+from tensor2robot_tpu.models.abstract_model import AbstractT2RModel
+from tensor2robot_tpu.models.regression_model import _DictOutput
+
+LOGITS = "logits"
+
+
+@gin.configurable
+class ClassificationModel(AbstractT2RModel):
+  """Softmax cross-entropy against integer labels; tracks accuracy."""
+
+  def __init__(self,
+               num_classes: int = 2,
+               hidden_sizes: Sequence[int] = (64, 64),
+               label_key: str = "label",
+               dropout_rate: float = 0.0,
+               **kwargs):
+    super().__init__(**kwargs)
+    self._num_classes = num_classes
+    self._hidden_sizes = tuple(hidden_sizes)
+    self._label_key = label_key
+    self._dropout_rate = dropout_rate
+
+  @property
+  def num_classes(self) -> int:
+    return self._num_classes
+
+  def create_network(self) -> nn.Module:
+
+    class _Logits(nn.Module):
+      hidden: tuple
+      num_classes: int
+      dropout: float
+      dtype: object
+
+      @nn.compact
+      def __call__(inner, features, train: bool = False):
+        x = MLP(hidden_sizes=inner.hidden,
+                output_size=inner.num_classes,
+                dropout_rate=inner.dropout,
+                dtype=inner.dtype)(features, train=train)
+        return {LOGITS: x}
+
+    return _Logits(self._hidden_sizes, self._num_classes,
+                   self._dropout_rate, self.device_dtype)
+
+  def model_train_fn(self, features, labels, outputs, mode
+                     ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    logits = outputs[LOGITS]
+    target = labels[self._label_key].reshape(logits.shape[0]).astype(
+        jnp.int32)
+    loss = optax.softmax_cross_entropy_with_integer_labels(
+        logits, target).mean()
+    accuracy = jnp.mean(
+        (jnp.argmax(logits, axis=-1) == target).astype(jnp.float32))
+    return loss, {"cross_entropy": loss, "accuracy": accuracy}

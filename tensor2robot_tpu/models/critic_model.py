@@ -1,0 +1,89 @@
+"""Critic (Q-function) model base — the QT-Opt foundation.
+
+Reference parity: tensor2robot `models/critic_model.py` — state+action →
+scalar Q, trained by MSE against a Bellman target label (the distributed
+target computation lived outside the repo; our in-repo version is in
+research/qtopt). SURVEY.md §3.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tensor2robot_tpu import config as gin
+from tensor2robot_tpu.layers.core import MLP, flatten_and_concat
+from tensor2robot_tpu.models.abstract_model import AbstractT2RModel
+
+Q_VALUE = "q_value"
+
+
+@gin.configurable
+class CriticModel(AbstractT2RModel):
+  """Q(state, action) regression against a target-Q label.
+
+  Subclasses declare specs with the action under `action_key`; the
+  default network concatenates state features with the action and
+  regresses a scalar. Sigmoid-bounded Q (grasp-success ∈ [0,1], as in
+  QT-Opt) is available via `sigmoid_q=True`, trained with cross-entropy
+  on the logit, which is better-conditioned than MSE near saturation.
+  """
+
+  def __init__(self,
+               hidden_sizes: Sequence[int] = (256, 256),
+               action_key: str = "action",
+               target_q_key: str = "target_q",
+               sigmoid_q: bool = False,
+               **kwargs):
+    super().__init__(**kwargs)
+    self._hidden_sizes = tuple(hidden_sizes)
+    self._action_key = action_key
+    self._target_q_key = target_q_key
+    self._sigmoid_q = sigmoid_q
+
+  @property
+  def action_key(self) -> str:
+    return self._action_key
+
+  @property
+  def sigmoid_q(self) -> bool:
+    return self._sigmoid_q
+
+  def create_network(self) -> nn.Module:
+
+    class _QNet(nn.Module):
+      hidden: tuple
+      dtype: object
+
+      @nn.compact
+      def __call__(inner, features, train: bool = False):
+        x = flatten_and_concat(features)  # state ++ action, flattened
+        logit = MLP(hidden_sizes=inner.hidden, output_size=1,
+                    dtype=inner.dtype)(x, train=train)
+        return {Q_VALUE: logit[..., 0]}
+
+    return _QNet(self._hidden_sizes, self.device_dtype)
+
+  def q_from_outputs(self, outputs) -> jax.Array:
+    q = outputs[Q_VALUE]
+    return jax.nn.sigmoid(q) if self._sigmoid_q else q
+
+  def model_train_fn(self, features, labels, outputs, mode
+                     ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    raw = outputs[Q_VALUE]
+    target = labels[self._target_q_key].reshape(raw.shape).astype(
+        raw.dtype)
+    if self._sigmoid_q:
+      # Cross-entropy on the logit against a [0,1] target.
+      loss = jnp.mean(
+          jnp.maximum(raw, 0) - raw * target +
+          jnp.log1p(jnp.exp(-jnp.abs(raw))))
+      q = jax.nn.sigmoid(raw)
+    else:
+      loss = jnp.mean(jnp.square(raw - target))
+      q = raw
+    return loss, {"q_loss": loss, "q_mean": jnp.mean(q),
+                  "target_q_mean": jnp.mean(target)}

@@ -1,0 +1,48 @@
+"""The minimal model contract the trainer and predictors depend on.
+
+Reference parity: tensor2robot `models/model_interface.py` —
+`ModelInterface` declaring the spec getters and step builders consumed by
+`train_eval.train_eval_model` (SURVEY.md §2 L5).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Optional
+
+from tensor2robot_tpu.data.abstract_input_generator import Mode
+from tensor2robot_tpu.specs import TensorSpecStruct
+
+
+class ModelInterface(abc.ABC):
+  """What the orchestration layer needs from any model."""
+
+  @abc.abstractmethod
+  def get_feature_specification(self, mode: Mode) -> TensorSpecStruct:
+    """Model-side (post-preprocessor) feature specs."""
+
+  @abc.abstractmethod
+  def get_label_specification(
+      self, mode: Mode) -> Optional[TensorSpecStruct]:
+    """Model-side (post-preprocessor) label specs."""
+
+  @property
+  @abc.abstractmethod
+  def preprocessor(self):
+    """The AbstractPreprocessor bridging wire specs to model specs."""
+
+  @abc.abstractmethod
+  def create_train_state(self, rng, batch_size: int = 1):
+    """Initializes parameters + optimizer state."""
+
+  @abc.abstractmethod
+  def train_step(self, state, features, labels, rng):
+    """Pure (state, batch, rng) -> (state, metrics); jit/pjit-able."""
+
+  @abc.abstractmethod
+  def eval_step(self, state, features, labels):
+    """Pure (state, batch) -> metrics; jit/pjit-able."""
+
+  @abc.abstractmethod
+  def predict_step(self, state, features):
+    """Pure (state, features) -> outputs; jit/pjit-able (serving path)."""

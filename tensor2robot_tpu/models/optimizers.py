@@ -1,0 +1,109 @@
+"""Configurable optimizer factory on optax.
+
+Reference parity: tensor2robot `models/optimizers.py` — gin-configurable
+optimizer creation, learning-rate schedules, gradient clipping, and the
+TPU cross-shard wrapping (SURVEY.md §3). TPU-native: there is no
+CrossShardOptimizer equivalent to wrap — gradient all-reduce over the
+mesh's data axis is inserted by GSPMD when the train step is jitted with
+sharded batch / replicated params, riding ICI. What remains configurable
+here is the optax chain.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Union
+
+import optax
+
+from tensor2robot_tpu import config as gin
+
+ScheduleOrFloat = Union[float, optax.Schedule]
+
+
+@gin.configurable
+def create_lr_schedule(
+    learning_rate: float = 1e-4,
+    schedule: str = "constant",
+    warmup_steps: int = 0,
+    decay_steps: int = 100_000,
+    decay_rate: float = 0.96,
+    end_learning_rate: float = 0.0,
+    staircase: bool = False,
+) -> optax.Schedule:
+  """Builds a learning-rate schedule.
+
+  Supported: constant, exponential_decay, cosine_decay, linear_decay —
+  each with optional linear warmup.
+  """
+  if schedule == "constant":
+    base = optax.constant_schedule(learning_rate)
+  elif schedule == "exponential_decay":
+    base = optax.exponential_decay(
+        init_value=learning_rate, transition_steps=decay_steps,
+        decay_rate=decay_rate, staircase=staircase,
+        end_value=end_learning_rate or None)
+  elif schedule == "cosine_decay":
+    base = optax.cosine_decay_schedule(
+        init_value=learning_rate, decay_steps=decay_steps,
+        alpha=end_learning_rate / max(learning_rate, 1e-12))
+  elif schedule == "linear_decay":
+    base = optax.linear_schedule(
+        init_value=learning_rate, end_value=end_learning_rate,
+        transition_steps=decay_steps)
+  else:
+    raise ValueError(f"Unknown lr schedule: {schedule!r}")
+  if warmup_steps > 0:
+    warmup = optax.linear_schedule(0.0, learning_rate, warmup_steps)
+    return optax.join_schedules([warmup, base], [warmup_steps])
+  return base
+
+
+@gin.configurable
+def create_optimizer(
+    optimizer_name: str = "adam",
+    learning_rate: ScheduleOrFloat = 1e-4,
+    momentum: float = 0.9,
+    beta1: float = 0.9,
+    beta2: float = 0.999,
+    epsilon: float = 1e-8,
+    weight_decay: float = 0.0,
+    gradient_clip_norm: Optional[float] = None,
+    gradient_clip_value: Optional[float] = None,
+    use_lr_schedule: bool = False,
+) -> optax.GradientTransformation:
+  """gin-configurable optimizer factory (reference: create_optimizer).
+
+  `use_lr_schedule=True` pulls the rate from `create_lr_schedule()` so
+  gin configs can bind schedule parameters separately.
+  """
+  lr: ScheduleOrFloat = create_lr_schedule() if use_lr_schedule \
+      else learning_rate
+  name = optimizer_name.lower()
+  if name == "adam":
+    opt = optax.adam(lr, b1=beta1, b2=beta2, eps=epsilon)
+  elif name == "adamw":
+    opt = optax.adamw(lr, b1=beta1, b2=beta2, eps=epsilon,
+                      weight_decay=weight_decay)
+  elif name == "sgd":
+    opt = optax.sgd(lr)
+  elif name == "momentum":
+    opt = optax.sgd(lr, momentum=momentum)
+  elif name == "rmsprop":
+    opt = optax.rmsprop(lr, momentum=momentum, eps=epsilon)
+  elif name == "adagrad":
+    opt = optax.adagrad(lr, eps=epsilon)
+  elif name == "lamb":
+    opt = optax.lamb(lr, b1=beta1, b2=beta2, eps=epsilon,
+                     weight_decay=weight_decay)
+  else:
+    raise ValueError(f"Unknown optimizer: {optimizer_name!r}")
+
+  chain = []
+  if gradient_clip_norm is not None:
+    chain.append(optax.clip_by_global_norm(gradient_clip_norm))
+  if gradient_clip_value is not None:
+    chain.append(optax.clip(gradient_clip_value))
+  if weight_decay and name not in ("adamw", "lamb"):
+    chain.append(optax.add_decayed_weights(weight_decay))
+  chain.append(opt)
+  return optax.chain(*chain) if len(chain) > 1 else opt

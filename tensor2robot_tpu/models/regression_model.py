@@ -1,0 +1,74 @@
+"""Regression model base (reference: models/regression_model.py).
+
+Subclasses declare specs; the default network is an MLP over all float
+features, the default loss MSE against `labels[label_key]`. The network
+output convention is a dict with key `inference_output` (matching the
+reference's serving signature naming).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tensor2robot_tpu import config as gin
+from tensor2robot_tpu.data.abstract_input_generator import Mode
+from tensor2robot_tpu.layers.core import MLP
+from tensor2robot_tpu.models.abstract_model import AbstractT2RModel
+from tensor2robot_tpu.specs import TensorSpecStruct
+
+INFERENCE_OUTPUT = "inference_output"
+
+
+class _DictOutput(nn.Module):
+  """Wraps a backbone so outputs follow the {'inference_output': ...} convention."""
+
+  backbone: nn.Module
+
+  @nn.compact
+  def __call__(self, features, train: bool = False):
+    out = self.backbone(features, train=train)
+    if isinstance(out, (dict, TensorSpecStruct)):
+      return out
+    return {INFERENCE_OUTPUT: out}
+
+
+@gin.configurable
+class RegressionModel(AbstractT2RModel):
+  """MSE regression against a declared label key."""
+
+  def __init__(self,
+               output_size: int = 1,
+               hidden_sizes: Sequence[int] = (64, 64),
+               label_key: str = "target",
+               dropout_rate: float = 0.0,
+               **kwargs):
+    super().__init__(**kwargs)
+    self._output_size = output_size
+    self._hidden_sizes = tuple(hidden_sizes)
+    self._label_key = label_key
+    self._dropout_rate = dropout_rate
+
+  @property
+  def label_key(self) -> str:
+    return self._label_key
+
+  def create_network(self) -> nn.Module:
+    return _DictOutput(MLP(
+        hidden_sizes=self._hidden_sizes,
+        output_size=self._output_size,
+        dropout_rate=self._dropout_rate,
+        dtype=self.device_dtype,
+    ))
+
+  def model_train_fn(self, features, labels, outputs, mode
+                     ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    prediction = outputs[INFERENCE_OUTPUT]
+    target = labels[self._label_key]
+    target = target.reshape(prediction.shape).astype(prediction.dtype)
+    loss = jnp.mean(jnp.square(prediction - target))
+    return loss, {"mse": loss,
+                  "mae": jnp.mean(jnp.abs(prediction - target))}

@@ -1,0 +1,11 @@
+"""Device-side preprocessors (reference: tensor2robot preprocessors/)."""
+
+from tensor2robot_tpu.preprocessors.abstract_preprocessor import (
+    AbstractPreprocessor,
+)
+from tensor2robot_tpu.preprocessors.noop_preprocessor import NoOpPreprocessor
+from tensor2robot_tpu.preprocessors.image_preprocessor import (
+    ImagePreprocessor,
+    TPUCompatPreprocessorWrapper,
+)
+from tensor2robot_tpu.preprocessors import image_transformations

@@ -1,0 +1,81 @@
+"""Preprocessor protocol: declared in/out spec transforms.
+
+Reference parity: tensor2robot `preprocessors/abstract_preprocessor.py`
+(`AbstractPreprocessor.{preprocess, get_in_feature_specification,
+get_out_feature_specification, ...}`; SURVEY.md §3).
+
+TPU-native redesign: `preprocess` is a PURE jax function `(features,
+labels, mode, rng) -> (features, labels)` that is traced into the jitted
+train/eval step — image crops, distortions, and dtype casts run on the
+TPU, fused by XLA into the step program (the reference ran these in the
+host tf.data pipeline; device-side preprocessing keeps the host free to
+feed the infeed and the uint8→bf16 cast after transfer halves H2D
+bytes). Anything not jax-traceable (jpeg decode) belongs to the data
+layer, host-side, exactly as in the reference.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Optional, Tuple
+
+import jax
+
+from tensor2robot_tpu import specs
+from tensor2robot_tpu.data.abstract_input_generator import Mode
+from tensor2robot_tpu.specs import TensorSpecStruct
+
+
+class AbstractPreprocessor(abc.ABC):
+  """Transforms wire-side batches into model-side batches, on device.
+
+  Spec contract (same as the reference):
+    * `get_in_*_specification(mode)`  — what the data layer must deliver.
+    * `get_out_*_specification(mode)` — what the model receives.
+  """
+
+  def __init__(self,
+               model_feature_specification_fn=None,
+               model_label_specification_fn=None):
+    """Args are mode→spec callables, usually the model's spec getters."""
+    self._model_feature_specification_fn = model_feature_specification_fn
+    self._model_label_specification_fn = model_label_specification_fn
+
+  def model_feature_specification(self, mode: Mode) -> TensorSpecStruct:
+    if self._model_feature_specification_fn is None:
+      raise ValueError("No model feature specification bound.")
+    return specs.flatten_spec_structure(
+        self._model_feature_specification_fn(mode))
+
+  def model_label_specification(self, mode: Mode) -> Optional[TensorSpecStruct]:
+    if self._model_label_specification_fn is None:
+      return None
+    spec = self._model_label_specification_fn(mode)
+    return None if spec is None else specs.flatten_spec_structure(spec)
+
+  @abc.abstractmethod
+  def get_in_feature_specification(self, mode: Mode) -> TensorSpecStruct:
+    ...
+
+  @abc.abstractmethod
+  def get_in_label_specification(self, mode: Mode) -> Optional[TensorSpecStruct]:
+    ...
+
+  @abc.abstractmethod
+  def get_out_feature_specification(self, mode: Mode) -> TensorSpecStruct:
+    ...
+
+  @abc.abstractmethod
+  def get_out_label_specification(self, mode: Mode) -> Optional[TensorSpecStruct]:
+    ...
+
+  @abc.abstractmethod
+  def preprocess(
+      self,
+      features: TensorSpecStruct,
+      labels: Optional[TensorSpecStruct],
+      mode: Mode,
+      rng: Optional[jax.Array] = None,
+  ) -> Tuple[TensorSpecStruct, Optional[TensorSpecStruct]]:
+    """Pure, jit-traceable transform from in-specs to out-specs."""
+    ...

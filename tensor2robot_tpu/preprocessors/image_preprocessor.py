@@ -1,0 +1,173 @@
+"""Spec-transformation image preprocessor.
+
+Reference parity: tensor2robot `preprocessors/
+spec_transformation_preprocessor.py` + the image crop/distort train
+pipeline (SURVEY.md §3). Declares uint8 wire images, emits cropped /
+resized / distorted float (or bfloat16) model images on device.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu import config as gin
+from tensor2robot_tpu import specs
+from tensor2robot_tpu.data.abstract_input_generator import Mode
+from tensor2robot_tpu.preprocessors import image_transformations as imt
+from tensor2robot_tpu.preprocessors.abstract_preprocessor import (
+    AbstractPreprocessor,
+)
+from tensor2robot_tpu.specs import ExtendedTensorSpec, TensorSpecStruct
+
+
+@gin.configurable
+class ImagePreprocessor(AbstractPreprocessor):
+  """Crop/resize/distort the declared image keys, cast the rest.
+
+  The model's out-spec image shapes define the target size. The wire
+  (in-spec) image is `src_height × src_width` uint8; train mode random-
+  crops to the target and applies photometric distortions, eval mode
+  center-crops. Non-image float features pass through with a dtype cast
+  to the model dtype.
+  """
+
+  def __init__(self,
+               model_feature_specification_fn=None,
+               model_label_specification_fn=None,
+               image_keys: Optional[Sequence[str]] = None,
+               src_height: int = 512,
+               src_width: int = 640,
+               distort: bool = True,
+               max_brightness_delta: float = 0.125,
+               contrast_range: Tuple[float, float] = (0.5, 1.5),
+               saturation_range: Tuple[float, float] = (0.5, 1.5),
+               max_hue_delta: float = 0.2,
+               noise_stddev: float = 0.0):
+    super().__init__(model_feature_specification_fn,
+                     model_label_specification_fn)
+    self._image_keys = list(image_keys) if image_keys else None
+    self._src_height = src_height
+    self._src_width = src_width
+    self._distort = distort
+    self._distort_kwargs = dict(
+        max_brightness_delta=max_brightness_delta,
+        contrast_range=contrast_range,
+        saturation_range=saturation_range,
+        max_hue_delta=max_hue_delta,
+        noise_stddev=noise_stddev,
+    )
+
+  def _image_key_set(self, flat_specs) -> set:
+    if self._image_keys is not None:
+      return set(self._image_keys)
+    return {k for k, s in flat_specs.items()
+            if s.is_image or (len(s.shape) == 3 and s.shape[-1] in (1, 3))}
+
+  def get_in_feature_specification(self, mode: Mode) -> TensorSpecStruct:
+    flat = self.model_feature_specification(mode).to_flat_dict()
+    image_keys = self._image_key_set(flat)
+    out = {}
+    for key, spec in flat.items():
+      if key in image_keys:
+        channels = spec.shape[-1]
+        out[key] = spec.replace(
+            shape=(self._src_height, self._src_width, channels),
+            dtype=np.uint8)
+      else:
+        out[key] = spec
+    return TensorSpecStruct.from_flat_dict(out)
+
+  def get_in_label_specification(self, mode: Mode):
+    return self.model_label_specification(mode)
+
+  def get_out_feature_specification(self, mode: Mode) -> TensorSpecStruct:
+    return self.model_feature_specification(mode)
+
+  def get_out_label_specification(self, mode: Mode):
+    return self.model_label_specification(mode)
+
+  def preprocess(self, features, labels, mode: Mode,
+                 rng: Optional[jax.Array] = None):
+    out_specs = self.get_out_feature_specification(mode).to_flat_dict()
+    image_keys = self._image_key_set(out_specs)
+    flat = features.to_flat_dict()
+    if rng is None:
+      rng = jax.random.PRNGKey(0)
+    out = {}
+    for key, value in flat.items():
+      spec = out_specs.get(key)
+      if spec is None or key not in image_keys:
+        out[key] = value if spec is None else value.astype(spec.dtype)
+        continue
+      th, tw = spec.shape[-3], spec.shape[-2]
+      images = imt.to_float(value)
+      rng, crop_key, distort_key = jax.random.split(rng, 3)
+      if mode == Mode.TRAIN:
+        images = imt.random_crop(crop_key, images, th, tw) \
+            if (images.shape[-3], images.shape[-2]) != (th, tw) \
+            else images
+        if self._distort:
+          images = imt.apply_photometric_image_distortions(
+              distort_key, images, **self._distort_kwargs)
+      else:
+        if (images.shape[-3], images.shape[-2]) != (th, tw):
+          images = imt.center_crop(images, th, tw)
+      out[key] = images.astype(spec.dtype)
+    return TensorSpecStruct.from_flat_dict(out), labels
+
+
+@gin.configurable
+class TPUCompatPreprocessorWrapper(AbstractPreprocessor):
+  """Keeps uint8 on the wire, casts to the model dtype on device.
+
+  Reference parity: the TPU-compat wrapper noted in SURVEY.md §3
+  ("casting uint8→bf16/f32 on host [U-med]") — except TPU-native we cast
+  AFTER the H2D transfer, so images cross PCIe/ICI as uint8 (4× fewer
+  bytes than f32) and the cast fuses into the first conv.
+  """
+
+  def __init__(self, base: AbstractPreprocessor,
+               model_dtype=jnp.float32, scale: bool = True):
+    super().__init__()
+    self._base = base
+    self._model_dtype = model_dtype
+    self._scale = scale
+
+  def get_in_feature_specification(self, mode: Mode) -> TensorSpecStruct:
+    return self._base.get_in_feature_specification(mode)
+
+  def get_in_label_specification(self, mode: Mode):
+    return self._base.get_in_label_specification(mode)
+
+  def _cast_spec(self, spec_struct):
+    if spec_struct is None:
+      return None
+    return specs.replace_dtype(spec_struct, np.uint8, self._model_dtype)
+
+  def get_out_feature_specification(self, mode: Mode) -> TensorSpecStruct:
+    return self._cast_spec(self._base.get_out_feature_specification(mode))
+
+  def get_out_label_specification(self, mode: Mode):
+    return self._cast_spec(self._base.get_out_label_specification(mode))
+
+  def _cast(self, struct):
+    if struct is None:
+      return None
+    flat = struct.to_flat_dict()
+    out = {}
+    for key, value in flat.items():
+      if value.dtype == jnp.uint8:
+        value = value.astype(self._model_dtype)
+        if self._scale:
+          value = value / jnp.asarray(255.0, self._model_dtype)
+      out[key] = value
+    return TensorSpecStruct.from_flat_dict(out)
+
+  def preprocess(self, features, labels, mode: Mode,
+                 rng: Optional[jax.Array] = None):
+    features, labels = self._base.preprocess(features, labels, mode, rng)
+    return self._cast(features), self._cast(labels)

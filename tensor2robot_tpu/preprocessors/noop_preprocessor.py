@@ -1,0 +1,40 @@
+"""Identity preprocessor (reference: preprocessors/noop_preprocessor.py)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+
+from tensor2robot_tpu import config as gin
+from tensor2robot_tpu.data.abstract_input_generator import Mode
+from tensor2robot_tpu.preprocessors.abstract_preprocessor import (
+    AbstractPreprocessor,
+)
+from tensor2robot_tpu.specs import TensorSpecStruct
+
+
+@gin.configurable
+class NoOpPreprocessor(AbstractPreprocessor):
+  """Wire specs == model specs; preprocess is identity."""
+
+  def get_in_feature_specification(self, mode: Mode) -> TensorSpecStruct:
+    return self.model_feature_specification(mode)
+
+  def get_in_label_specification(self, mode: Mode):
+    return self.model_label_specification(mode)
+
+  def get_out_feature_specification(self, mode: Mode) -> TensorSpecStruct:
+    return self.model_feature_specification(mode)
+
+  def get_out_label_specification(self, mode: Mode):
+    return self.model_label_specification(mode)
+
+  def preprocess(
+      self,
+      features: TensorSpecStruct,
+      labels: Optional[TensorSpecStruct],
+      mode: Mode,
+      rng: Optional[jax.Array] = None,
+  ) -> Tuple[TensorSpecStruct, Optional[TensorSpecStruct]]:
+    return features, labels

@@ -1,0 +1,285 @@
+"""train_eval_model: the training/eval/export orchestrator.
+
+Reference parity: tensor2robot `train_eval.py` —
+`train_eval_model(model, input_generator_train, input_generator_eval,
+max_train_steps, eval_steps, create_exporters_fn, use_tpu, ...)` building
+an (TPU)Estimator and running train / eval / continuous-eval / export
+(SURVEY.md §4.1).
+
+TPU-native redesign: no Estimator. The model's pure `train_step` is
+jitted ONCE over a named device mesh with the batch sharded along the
+data axis and state replicated (or sharded per the model's rules);
+GSPMD inserts the ICI all-reduce. The host loop is thin: pull a
+prefetched sharded batch, call the compiled step, occasionally log /
+checkpoint — state stays on device the whole time (the reference paid a
+host round-trip per `iterations_per_loop`). Checkpointing is async
+orbax; resume is automatic from the latest checkpoint in `model_dir`.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Any, Callable, Dict, Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu import config as gin
+from tensor2robot_tpu.data.abstract_input_generator import (
+    AbstractInputGenerator,
+    Mode,
+)
+from tensor2robot_tpu.data import prefetch as prefetch_lib
+from tensor2robot_tpu.hooks import Hook, HookList
+from tensor2robot_tpu.models.model_interface import ModelInterface
+from tensor2robot_tpu.parallel import mesh as mesh_lib
+from tensor2robot_tpu.utils import checkpoints as ckpt_lib
+
+log = logging.getLogger(__name__)
+
+# Orbax emits dozens of INFO lines per checkpoint; keep the training log
+# readable by default (users can re-raise the level explicitly).
+for _noisy in ("orbax", "absl"):
+  logging.getLogger(_noisy).setLevel(logging.WARNING)
+
+
+class MetricLogger:
+  """Scalar metric sink: stdout + JSONL file per tag (train/eval)."""
+
+  def __init__(self, model_dir: str):
+    self._model_dir = model_dir
+    os.makedirs(model_dir, exist_ok=True)
+    self._files: Dict[str, Any] = {}
+
+  def write(self, tag: str, step: int, metrics: Dict[str, Any]) -> None:
+    scalars = {k: float(np.asarray(v)) for k, v in metrics.items()}
+    if tag not in self._files:
+      self._files[tag] = open(
+          os.path.join(self._model_dir, f"metrics_{tag}.jsonl"), "a")
+    record = {"step": int(step), **scalars}
+    self._files[tag].write(json.dumps(record) + "\n")
+    self._files[tag].flush()
+    rendered = ", ".join(f"{k}={v:.5g}" for k, v in scalars.items())
+    log.info("[%s] step %d: %s", tag, step, rendered)
+
+  def close(self) -> None:
+    for f in self._files.values():
+      f.close()
+    self._files.clear()
+
+
+def _compile_steps(model: ModelInterface, mesh, donate: bool = True):
+  """Jits train/eval steps with mesh shardings (batch on data axis)."""
+  repl = mesh_lib.replicated(mesh)
+  batch = mesh_lib.batch_sharding(mesh)
+  train_step = jax.jit(
+      model.train_step,
+      in_shardings=(repl, batch, batch, repl),
+      out_shardings=(repl, repl),
+      donate_argnums=(0,) if donate else (),
+  )
+  eval_step = jax.jit(
+      model.eval_step,
+      in_shardings=(repl, batch, batch),
+      out_shardings=repl,
+  )
+  return train_step, eval_step
+
+
+def _run_eval(model, eval_step, state, input_generator_eval, mesh,
+              eval_steps: int, batch_size: Optional[int]) -> Dict[str, float]:
+  """Averages eval metrics over `eval_steps` batches."""
+  stream = input_generator_eval.create_dataset(
+      Mode.EVAL, batch_size=batch_size)
+  prefetcher = prefetch_lib.ShardedPrefetcher(
+      stream, mesh_lib.batch_sharding(mesh), buffer_size=2)
+  totals: Dict[str, float] = {}
+  count = 0
+  try:
+    for features, labels in prefetcher:
+      metrics = eval_step(state, features, labels)
+      for key, value in metrics.items():
+        totals[key] = totals.get(key, 0.0) + float(np.asarray(value))
+      count += 1
+      if count >= eval_steps:
+        break
+  finally:
+    prefetcher.close()
+  if count == 0:
+    return {}
+  return {k: v / count for k, v in totals.items()}
+
+
+@gin.configurable
+def train_eval_model(
+    model: ModelInterface = gin.REQUIRED,
+    model_dir: str = gin.REQUIRED,
+    input_generator_train: Optional[AbstractInputGenerator] = None,
+    input_generator_eval: Optional[AbstractInputGenerator] = None,
+    max_train_steps: int = 1000,
+    eval_steps: int = 10,
+    eval_every_steps: Optional[int] = None,
+    save_checkpoints_steps: int = 500,
+    max_checkpoints_to_keep: int = 5,
+    batch_size: Optional[int] = None,
+    eval_batch_size: Optional[int] = None,
+    mesh: Optional[jax.sharding.Mesh] = None,
+    create_exporters_fn: Optional[Callable] = None,
+    hooks: Iterable[Hook] = (),
+    log_every_steps: int = 100,
+    seed: int = 0,
+    init_batch_size: int = 2,
+):
+  """Trains (with interleaved eval) and exports; resumes automatically.
+
+  Returns the final TrainState (on device, replicated over the mesh).
+  """
+  if mesh is None:
+    mesh = mesh_lib.create_mesh()
+  os.makedirs(model_dir, exist_ok=True)
+  metric_logger = MetricLogger(model_dir)
+  hook_list = HookList(list(hooks))
+
+  # --- bind generators to the model's wire specs ---
+  if input_generator_train is not None:
+    input_generator_train.set_specification_from_model(model, Mode.TRAIN)
+  if input_generator_eval is not None:
+    input_generator_eval.set_specification_from_model(model, Mode.EVAL)
+
+  # --- init / resume state ---
+  rng = jax.random.PRNGKey(seed)
+  state = model.create_train_state(rng, batch_size=init_batch_size)
+  state = jax.device_put(state, mesh_lib.replicated(mesh))
+  resume_step = ckpt_lib.latest_step(model_dir)
+  if resume_step is not None:
+    log.info("Resuming from checkpoint at step %d in %s", resume_step,
+             model_dir)
+    state = ckpt_lib.restore_state(model_dir, like=state,
+                                   step=resume_step)
+
+  writer = ckpt_lib.CheckpointWriter(
+      model_dir, max_to_keep=max_checkpoints_to_keep)
+  train_step, eval_step = _compile_steps(model, mesh)
+  hook_list.begin(model, model_dir)
+
+  step = int(np.asarray(jax.device_get(state.step)))
+  final_metrics: Dict[str, Any] = {}
+  try:
+    if input_generator_train is not None and step < max_train_steps:
+      stream = input_generator_train.create_dataset(
+          Mode.TRAIN, batch_size=batch_size)
+      prefetcher = train_prefetcher = prefetch_lib.ShardedPrefetcher(
+          stream, mesh_lib.batch_sharding(mesh), buffer_size=2)
+      step_rng = jax.random.PRNGKey(seed + 1)
+      t_last = time.time()
+      steps_since_log = 0
+      last_saved_step = resume_step
+      for features, labels in prefetcher:
+        if step >= max_train_steps:
+          break
+        state, metrics = train_step(
+            state, features, labels, jax.random.fold_in(step_rng, step))
+        step += 1
+        steps_since_log += 1
+        hook_list.after_step(step, metrics)
+
+        if step % log_every_steps == 0 or step == max_train_steps:
+          # One blocking device read per log interval only.
+          scalars = jax.device_get(metrics)
+          dt = time.time() - t_last
+          scalars["steps_per_sec"] = steps_since_log / max(dt, 1e-9)
+          metric_logger.write("train", step, scalars)
+          final_metrics = scalars
+          t_last = time.time()
+          steps_since_log = 0
+
+        if step % save_checkpoints_steps == 0 or step == max_train_steps:
+          writer.save(step, jax.device_get(state))
+          last_saved_step = step
+          hook_list.after_checkpoint(step, state, model_dir)
+
+        # Interleaved eval runs on its own cadence, independent of the
+        # checkpoint interval.
+        if (input_generator_eval is not None and eval_every_steps and
+            step % eval_every_steps == 0 and step != max_train_steps):
+          eval_metrics = _run_eval(
+              model, eval_step, state, input_generator_eval, mesh,
+              eval_steps, eval_batch_size or batch_size)
+          metric_logger.write("eval", step, eval_metrics)
+
+      train_prefetcher.close()
+      # Final checkpoint if the loop ended off-interval.
+      if last_saved_step != step:
+        writer.save(step, jax.device_get(state))
+        hook_list.after_checkpoint(step, state, model_dir)
+
+    # --- final eval ---
+    if input_generator_eval is not None:
+      eval_metrics = _run_eval(
+          model, eval_step, state, input_generator_eval, mesh,
+          eval_steps, eval_batch_size or batch_size)
+      if eval_metrics:
+        metric_logger.write("eval", step, eval_metrics)
+
+    # --- exporters ---
+    if create_exporters_fn is not None:
+      for exporter in create_exporters_fn(model):
+        exporter.export(model, state, model_dir)
+
+    hook_list.end(step, state, model_dir)
+  finally:
+    writer.close()
+    metric_logger.close()
+  return state
+
+
+@gin.configurable
+def continuous_eval(
+    model: ModelInterface = gin.REQUIRED,
+    model_dir: str = gin.REQUIRED,
+    input_generator_eval: AbstractInputGenerator = gin.REQUIRED,
+    eval_steps: int = 10,
+    eval_batch_size: Optional[int] = None,
+    mesh: Optional[jax.sharding.Mesh] = None,
+    timeout_secs: Optional[float] = None,
+    poll_interval_secs: float = 2.0,
+    max_evals: Optional[int] = None,
+    seed: int = 0,
+    init_batch_size: int = 2,
+):
+  """Polls `model_dir` for new checkpoints and evals each one.
+
+  Reference parity: the continuous-eval mode of `train_eval_model`
+  (SURVEY.md §4.1). Returns {step: metrics} for all evaluated steps.
+  """
+  if mesh is None:
+    mesh = mesh_lib.create_mesh()
+  input_generator_eval.set_specification_from_model(model, Mode.EVAL)
+  state = model.create_train_state(jax.random.PRNGKey(seed),
+                                   batch_size=init_batch_size)
+  state = jax.device_put(state, mesh_lib.replicated(mesh))
+  _, eval_step = _compile_steps(model, mesh, donate=False)
+  metric_logger = MetricLogger(model_dir)
+
+  results: Dict[int, Dict[str, float]] = {}
+  last_step = None
+  try:
+    while max_evals is None or len(results) < max_evals:
+      new_step = ckpt_lib.wait_for_new_checkpoint(
+          model_dir, last_step, timeout_secs=timeout_secs,
+          poll_interval_secs=poll_interval_secs)
+      if new_step is None:
+        break
+      state = ckpt_lib.restore_state(model_dir, like=state, step=new_step)
+      metrics = _run_eval(model, eval_step, state, input_generator_eval,
+                          mesh, eval_steps, eval_batch_size)
+      metric_logger.write("eval", new_step, metrics)
+      results[new_step] = metrics
+      last_step = new_step
+  finally:
+    metric_logger.close()
+  return results

@@ -1,0 +1,187 @@
+"""Orbax-backed checkpointing: save/restore/poll, warm-start, resharding.
+
+Reference parity: Estimator auto-checkpointing + `maybe_init_from_checkpoint`
+warm start + predictors polling `model_dir` for new checkpoints
+(SURVEY.md §6 "Checkpoint/resume"). TPU-native: orbax with async save
+(device→host copy happens immediately, serialization overlaps training)
+and restore-with-resharding (restored arrays adopt whatever sharding the
+target abstract pytree carries — checkpoints move freely between mesh
+shapes).
+
+Layout: `<model_dir>/ckpt/<step>/{state,params}` — `state` is the full
+TrainState pytree; `params` duplicates the (small, CNN-scale) parameter
+subtree so warm-start and predictors can restore params without knowing
+the optimizer. A `<step>` directory is only visible once finalized
+(orbax writes atomically), so pollers never see partial checkpoints.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import time
+from typing import Any, List, Optional
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+CKPT_SUBDIR = "ckpt"
+
+
+def _ckpt_root(model_dir: str) -> str:
+  return os.path.join(model_dir, CKPT_SUBDIR)
+
+
+def list_steps(model_dir: str) -> List[int]:
+  root = _ckpt_root(model_dir)
+  if not os.path.isdir(root):
+    return []
+  steps = []
+  for entry in os.listdir(root):
+    if re.fullmatch(r"\d+", entry) and not entry.endswith(".tmp"):
+      # Only finalized orbax dirs (atomic rename) contain state/.
+      if os.path.isdir(os.path.join(root, entry, "state")) or \
+          os.path.isdir(os.path.join(root, entry, "params")):
+        steps.append(int(entry))
+  return sorted(steps)
+
+
+def latest_step(model_dir: str) -> Optional[int]:
+  steps = list_steps(model_dir)
+  return steps[-1] if steps else None
+
+
+class CheckpointWriter:
+  """Async orbax writer with retention.
+
+  `save()` returns as soon as device arrays are copied to host; disk
+  serialization overlaps subsequent training steps (the reference's
+  checkpointing blocked the Estimator loop).
+  """
+
+  def __init__(self, model_dir: str, max_to_keep: Optional[int] = 5):
+    self._root = _ckpt_root(model_dir)
+    os.makedirs(self._root, exist_ok=True)
+    self._checkpointer = ocp.AsyncCheckpointer(
+        ocp.StandardCheckpointHandler())
+    self._params_checkpointer = ocp.AsyncCheckpointer(
+        ocp.StandardCheckpointHandler())
+    self._max_to_keep = max_to_keep
+    self._pending_steps: set = set()
+
+  def save(self, step: int, state: Any, params: Optional[Any] = None,
+           force: bool = False) -> None:
+    step_dir = os.path.join(self._root, str(int(step)))
+    self._checkpointer.save(
+        os.path.join(step_dir, "state"),
+        args=ocp.args.StandardSave(state), force=force)
+    if params is None:
+      params = getattr(state, "params", None)
+    if params is not None:
+      self._params_checkpointer.save(
+          os.path.join(step_dir, "params"),
+          args=ocp.args.StandardSave(params), force=force)
+    self._pending_steps.add(int(step))
+    self._gc()
+
+  def wait(self) -> None:
+    self._checkpointer.wait_until_finished()
+    self._params_checkpointer.wait_until_finished()
+    self._pending_steps.clear()
+
+  def close(self) -> None:
+    self.wait()
+    self._checkpointer.close()
+    self._params_checkpointer.close()
+
+  def _gc(self) -> None:
+    if self._max_to_keep is None:
+      return
+    import shutil
+    steps = sorted(
+        int(e) for e in os.listdir(self._root)
+        if re.fullmatch(r"\d+", e))
+    excess = len(steps) - self._max_to_keep
+    for step in steps[:max(excess, 0)]:
+      # Steady-state deletions target old, long-finished saves; only
+      # block when the victim is still in flight (pathological
+      # max_to_keep < save cadence), so async overlap is preserved.
+      if step in self._pending_steps:
+        self.wait()
+      shutil.rmtree(os.path.join(self._root, str(step)),
+                    ignore_errors=True)
+
+
+def _abstract_like(tree: Any) -> Any:
+  """Target pytree of ShapeDtypeStructs carrying shardings for restore."""
+
+  def leaf(x):
+    if isinstance(x, jax.Array):
+      return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+    if isinstance(x, (np.ndarray, np.generic)):
+      return jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype)
+    return x
+
+  return jax.tree_util.tree_map(leaf, tree)
+
+
+def restore_state(model_dir: str, like: Any,
+                  step: Optional[int] = None) -> Any:
+  """Restores a full TrainState; arrays adopt `like`'s shardings."""
+  if step is None:
+    step = latest_step(model_dir)
+    if step is None:
+      raise FileNotFoundError(
+          f"No checkpoints found under {_ckpt_root(model_dir)}")
+  path = os.path.join(_ckpt_root(model_dir), str(int(step)), "state")
+  with ocp.StandardCheckpointer() as checkpointer:
+    return checkpointer.restore(path, _abstract_like(like))
+
+
+def restore_params(path_or_model_dir: str, like: Any,
+                   step: Optional[int] = None) -> Any:
+  """Restores just params — for warm starts and predictors.
+
+  Accepts either a model_dir (picks latest step), a step dir, or a
+  direct params checkpoint path.
+  """
+  candidates = []
+  if step is not None:
+    candidates.append(os.path.join(
+        _ckpt_root(path_or_model_dir), str(int(step)), "params"))
+  else:
+    found = latest_step(path_or_model_dir)
+    if found is not None:
+      candidates.append(os.path.join(
+          _ckpt_root(path_or_model_dir), str(found), "params"))
+    candidates.append(os.path.join(path_or_model_dir, "params"))
+    candidates.append(path_or_model_dir)
+  for path in candidates:
+    if os.path.isdir(path):
+      with ocp.StandardCheckpointer() as checkpointer:
+        return checkpointer.restore(path, _abstract_like(like))
+  raise FileNotFoundError(
+      f"No params checkpoint found at any of: {candidates}")
+
+
+def wait_for_new_checkpoint(
+    model_dir: str,
+    last_step: Optional[int] = None,
+    timeout_secs: Optional[float] = None,
+    poll_interval_secs: float = 1.0,
+) -> Optional[int]:
+  """Blocks until a checkpoint newer than `last_step` appears.
+
+  Reference parity: predictors' poll/wait for new checkpoints
+  (SURVEY.md §4.4). Returns the new step, or None on timeout.
+  """
+  deadline = (time.time() + timeout_secs) if timeout_secs is not None \
+      else None
+  while True:
+    step = latest_step(model_dir)
+    if step is not None and (last_step is None or step > last_step):
+      return step
+    if deadline is not None and time.time() > deadline:
+      return None
+    time.sleep(poll_interval_secs)

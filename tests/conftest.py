@@ -7,7 +7,9 @@ tests the reference lacked). Must run before jax initializes its backends.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Overwrite, not setdefault: the environment pre-sets JAX_PLATFORMS=axon
+# (the real TPU tunnel); tests always run on the virtual CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
   os.environ["XLA_FLAGS"] = (
@@ -16,3 +18,10 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 # Keep TF (used only for TFRecord IO / jax2tf export) off any accelerator.
 os.environ.setdefault("CUDA_VISIBLE_DEVICES", "-1")
 os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+
+# Belt and braces: jax may already be imported (pytest plugin autoload),
+# in which case the env var was read too early. The config update works
+# as long as no backend has been initialized yet.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

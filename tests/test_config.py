@@ -174,3 +174,38 @@ class TestFilesAndDump:
     make_widget()
     dump = gin.operative_config_str()
     assert "make_widget.size = 8" in dump
+
+
+class TestReviewRegressions:
+  """Pinned behaviors from code-review findings."""
+
+  def test_unknown_configurable_binding_raises_at_parse(self):
+    with pytest.raises(gin.GinError, match="No configurable matching"):
+      gin.parse_config("fnn.x = 42")  # typo'd target
+
+  def test_unknown_binding_skipped_with_skip_unknown(self):
+    gin.parse_config("fnn.x = 42", skip_unknown=True)  # no raise
+
+  def test_fully_qualified_binding_applies(self):
+    gin.parse_config("tests.test_config.make_widget.size = 77")
+    assert make_widget()["size"] == 77
+
+  def test_compound_scope_beats_bare_scope(self):
+    gin.parse_config("""
+      a/b/make_widget.size = 1
+      b/make_widget.size = 2
+    """)
+    with gin.config_scope("a"):
+      with gin.config_scope("b"):
+        assert make_widget()["size"] == 1  # most specific scope wins
+
+  def test_external_configurable_does_not_mutate_original(self):
+    class Plain:
+      def __init__(self, x=1):
+        self.x = x
+
+    wrapped = gin.external_configurable(Plain, name="PlainThing")
+    gin.bind_parameter("PlainThing.x", 9)
+    assert Plain().x == 1       # original untouched
+    assert wrapped().x == 9     # wrapper injects
+    assert isinstance(wrapped(), Plain)

@@ -1,0 +1,193 @@
+"""Tests for the model abstraction, optimizers, and canonical models."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tensor2robot_tpu import specs
+from tensor2robot_tpu.data import Mode, RandomInputGenerator
+from tensor2robot_tpu.models import (
+    ClassificationModel,
+    CriticModel,
+    RegressionModel,
+    TrainState,
+    create_lr_schedule,
+    create_optimizer,
+)
+from tensor2robot_tpu.utils.mocks import (
+    MockClassificationModel,
+    MockCriticModel,
+    MockT2RModel,
+)
+
+
+def make_batch(model, mode=Mode.TRAIN, batch_size=8, seed=0):
+  features = specs.make_random_tensors(
+      model.get_feature_specification(mode), batch_size=batch_size,
+      seed=seed)
+  labels = specs.make_random_tensors(
+      model.get_label_specification(mode), batch_size=batch_size,
+      seed=seed + 1)
+  to_dev = lambda t: jax.tree_util.tree_map(jnp.asarray, t)
+  return to_dev(features), to_dev(labels)
+
+
+class TestOptimizers:
+
+  def test_factory_names(self):
+    for name in ["adam", "adamw", "sgd", "momentum", "rmsprop",
+                 "adagrad", "lamb"]:
+      tx = create_optimizer(optimizer_name=name, learning_rate=1e-3)
+      params = {"w": jnp.ones((3,))}
+      state = tx.init(params)
+      grads = {"w": jnp.ones((3,))}
+      updates, _ = tx.update(grads, state, params)
+      assert updates["w"].shape == (3,)
+
+  def test_unknown_raises(self):
+    with pytest.raises(ValueError, match="Unknown optimizer"):
+      create_optimizer(optimizer_name="nope")
+
+  def test_grad_clipping(self):
+    tx = create_optimizer(optimizer_name="sgd", learning_rate=1.0,
+                          gradient_clip_norm=1.0)
+    params = {"w": jnp.zeros((2,))}
+    state = tx.init(params)
+    grads = {"w": jnp.array([30.0, 40.0])}  # norm 50
+    updates, _ = tx.update(grads, state, params)
+    np.testing.assert_allclose(
+        np.asarray(updates["w"]), [-0.6, -0.8], rtol=1e-5)
+
+  def test_schedules(self):
+    for schedule in ["constant", "exponential_decay", "cosine_decay",
+                     "linear_decay"]:
+      sched = create_lr_schedule(learning_rate=1e-2, schedule=schedule,
+                                 warmup_steps=10, decay_steps=100)
+      assert float(sched(0)) == pytest.approx(0.0)
+      assert float(sched(10)) == pytest.approx(1e-2, rel=1e-3)
+
+  def test_unknown_schedule(self):
+    with pytest.raises(ValueError, match="Unknown lr schedule"):
+      create_lr_schedule(schedule="bogus")
+
+
+class TestMockRegressionModel:
+
+  def test_create_train_state(self):
+    model = MockT2RModel()
+    state = model.create_train_state(jax.random.PRNGKey(0))
+    assert int(state.step) == 0
+    assert "backbone" in jax.tree_util.tree_leaves_with_path(
+        state.params)[0][0][0].key or state.params  # params exist
+
+  def test_train_step_reduces_loss(self):
+    model = MockT2RModel()
+    state = model.create_train_state(jax.random.PRNGKey(0))
+    features, labels = make_batch(model)
+    # Learn a fixed target mapping.
+    step = jax.jit(model.train_step)
+    _, first_metrics = step(state, features, labels,
+                            jax.random.PRNGKey(1))
+    for i in range(60):
+      state, metrics = step(state, features, labels,
+                            jax.random.PRNGKey(i))
+    assert float(metrics["loss"]) < float(first_metrics["loss"])
+    assert int(state.step) == 60
+
+  def test_eval_and_predict_step(self):
+    model = MockT2RModel()
+    state = model.create_train_state(jax.random.PRNGKey(0))
+    features, labels = make_batch(model, Mode.EVAL)
+    metrics = jax.jit(model.eval_step)(state, features, labels)
+    assert "loss" in metrics and "mae" in metrics
+    outputs = jax.jit(model.predict_step)(state, features)
+    assert outputs["inference_output"].shape == (8, 2)
+
+  def test_deterministic_eval(self):
+    model = MockT2RModel()
+    state = model.create_train_state(jax.random.PRNGKey(0))
+    features, labels = make_batch(model, Mode.EVAL)
+    m1 = model.eval_step(state, features, labels)
+    m2 = model.eval_step(state, features, labels)
+    assert float(m1["loss"]) == float(m2["loss"])
+
+
+class TestClassificationModel:
+
+  def test_train_improves_accuracy(self):
+    import functools
+    model = MockClassificationModel(
+        create_optimizer_fn=functools.partial(
+            create_optimizer, optimizer_name="adam", learning_rate=1e-2))
+    state = model.create_train_state(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((64, 4)).astype(np.float32)
+    y = (x.sum(axis=-1) > 0).astype(np.int64).reshape(-1, 1)
+    features = {"x": jnp.asarray(x)}
+    labels = {"label": jnp.asarray(y)}
+    step = jax.jit(model.train_step)
+    for i in range(150):
+      state, metrics = step(state, features, labels,
+                            jax.random.PRNGKey(i))
+    assert float(metrics["accuracy"]) > 0.8
+
+
+class TestCriticModel:
+
+  def test_train_step(self):
+    model = MockCriticModel()
+    state = model.create_train_state(jax.random.PRNGKey(0))
+    features, labels = make_batch(model)
+    state, metrics = jax.jit(model.train_step)(
+        state, features, labels, jax.random.PRNGKey(0))
+    assert "q_loss" in metrics and np.isfinite(float(metrics["q_loss"]))
+
+  def test_sigmoid_q_bounded(self):
+    model = MockCriticModel(sigmoid_q=True)
+    state = model.create_train_state(jax.random.PRNGKey(0))
+    features, _ = make_batch(model)
+    prep_features, _ = model.preprocessor.preprocess(
+        features, None, Mode.PREDICT)
+    outputs, _ = model.inference_network_fn(
+        state.variables, prep_features, Mode.PREDICT)
+    q = model.q_from_outputs(outputs)
+    assert float(q.min()) >= 0.0 and float(q.max()) <= 1.0
+
+
+class TestWarmStart:
+
+  def test_init_from_checkpoint(self, tmp_path):
+    from tensor2robot_tpu.utils import checkpoints as ckpt_lib
+    model = MockT2RModel()
+    state = model.create_train_state(jax.random.PRNGKey(42))
+    writer = ckpt_lib.CheckpointWriter(str(tmp_path))
+    writer.save(0, state)
+    writer.close()
+
+    warm = MockT2RModel(init_from_checkpoint_path=str(tmp_path))
+    warm_state = warm.create_train_state(jax.random.PRNGKey(7))
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)),
+        state.params, warm_state.params)
+
+  def test_checkpoint_roundtrip_and_polling(self, tmp_path):
+    from tensor2robot_tpu.utils import checkpoints as ckpt_lib
+    model = MockT2RModel()
+    state = model.create_train_state(jax.random.PRNGKey(0))
+    writer = ckpt_lib.CheckpointWriter(str(tmp_path), max_to_keep=2)
+    for step in [0, 10, 20]:
+      writer.save(step, state.replace(step=jnp.asarray(step)))
+    writer.close()
+    # Retention: only 2 newest kept.
+    assert ckpt_lib.list_steps(str(tmp_path)) == [10, 20]
+    assert ckpt_lib.latest_step(str(tmp_path)) == 20
+    restored = ckpt_lib.restore_state(str(tmp_path), like=state)
+    assert int(restored.step) == 20
+    # Polling sees the newest immediately.
+    assert ckpt_lib.wait_for_new_checkpoint(
+        str(tmp_path), last_step=10, timeout_secs=1) == 20
+    assert ckpt_lib.wait_for_new_checkpoint(
+        str(tmp_path), last_step=20, timeout_secs=0.2) is None

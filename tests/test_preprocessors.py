@@ -1,0 +1,167 @@
+"""Tests for device-side preprocessors and image transformations."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.data import Mode
+from tensor2robot_tpu.preprocessors import (
+    ImagePreprocessor,
+    NoOpPreprocessor,
+    TPUCompatPreprocessorWrapper,
+    image_transformations as imt,
+)
+from tensor2robot_tpu.specs import ExtendedTensorSpec, TensorSpecStruct
+
+
+def model_feature_spec(mode=None):
+  st = TensorSpecStruct()
+  st.image = ExtendedTensorSpec(shape=(8, 8, 3), dtype=np.float32,
+                                name="image", data_format="jpeg")
+  st.state = ExtendedTensorSpec(shape=(4,), dtype=np.float32, name="state")
+  return st
+
+
+def model_label_spec(mode=None):
+  st = TensorSpecStruct()
+  st.target = ExtendedTensorSpec(shape=(2,), dtype=np.float32,
+                                 name="target")
+  return st
+
+
+class TestImageTransformations:
+
+  def setup_method(self):
+    self.key = jax.random.PRNGKey(0)
+    self.images = jax.random.uniform(self.key, (4, 16, 16, 3))
+
+  def test_center_crop(self):
+    out = imt.center_crop(self.images, 8, 8)
+    assert out.shape == (4, 8, 8, 3)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(self.images[:, 4:12, 4:12, :]))
+
+  def test_random_crop_shape_and_content(self):
+    out = imt.random_crop(self.key, self.images, 8, 8)
+    assert out.shape == (4, 8, 8, 3)
+    # Every crop must be a contiguous subwindow: check pixel membership.
+    src = np.asarray(self.images[0]).reshape(-1, 3)
+    crop = np.asarray(out[0]).reshape(-1, 3)
+    assert all(any(np.allclose(p, s) for s in src) for p in crop[:5])
+
+  def test_random_crop_full_size_identity(self):
+    out = imt.random_crop(self.key, self.images, 16, 16)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(self.images))
+
+  def test_resize(self):
+    out = imt.resize(self.images, 4, 4)
+    assert out.shape == (4, 4, 4, 3)
+
+  def test_flip(self):
+    out = imt.random_flip_left_right(self.key, self.images)
+    assert out.shape == self.images.shape
+
+  def test_to_float_uint8(self):
+    img = (np.arange(12, dtype=np.uint8).reshape(1, 2, 2, 3) * 20)
+    out = imt.to_float(jnp.asarray(img))
+    assert out.dtype == jnp.float32
+    assert float(out.max()) <= 1.0
+
+  def test_brightness_contrast_saturation_hue(self):
+    ones = jnp.ones((2, 4, 4, 3)) * 0.5
+    bright = imt.adjust_brightness(ones, jnp.array([0.1, -0.1]))
+    np.testing.assert_allclose(np.asarray(bright[0]), 0.6, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(bright[1]), 0.4, rtol=1e-5)
+    # Contrast of a constant image is identity.
+    contrast = imt.adjust_contrast(ones, jnp.array([1.7, 0.2]))
+    np.testing.assert_allclose(np.asarray(contrast), 0.5, atol=1e-5)
+    # Saturation of gray is identity.
+    sat = imt.adjust_saturation(ones, jnp.array([2.0, 0.0]))
+    np.testing.assert_allclose(np.asarray(sat), 0.5, atol=1e-5)
+    # Zero hue rotation is identity up to the YIQ matrices' precision
+    # (the standard 3-decimal matrices are approximate inverses).
+    hue = imt.adjust_hue(self.images, jnp.zeros((4,)))
+    np.testing.assert_allclose(np.asarray(hue), np.asarray(self.images),
+                               atol=5e-3)
+
+  def test_photometric_distortions_jit_and_range(self):
+    distort = jax.jit(imt.apply_photometric_image_distortions)
+    out = distort(self.key, self.images)
+    assert out.shape == self.images.shape
+    assert float(out.min()) >= 0.0 and float(out.max()) <= 1.0
+    # Different keys → different outputs.
+    out2 = distort(jax.random.PRNGKey(1), self.images)
+    assert not np.allclose(np.asarray(out), np.asarray(out2))
+
+
+class TestNoOpPreprocessor:
+
+  def test_identity(self):
+    p = NoOpPreprocessor(model_feature_spec, model_label_spec)
+    assert p.get_in_feature_specification(Mode.TRAIN) == \
+        p.get_out_feature_specification(Mode.TRAIN)
+    feats = TensorSpecStruct({"x": jnp.ones((2, 3))})
+    out_f, out_l = p.preprocess(feats, None, Mode.TRAIN)
+    assert out_f is feats and out_l is None
+
+
+class TestImagePreprocessor:
+
+  def make(self, distort=True):
+    return ImagePreprocessor(
+        model_feature_spec, model_label_spec,
+        src_height=12, src_width=12, distort=distort)
+
+  def test_in_spec_is_uint8_src_size(self):
+    p = self.make()
+    in_spec = p.get_in_feature_specification(Mode.TRAIN)
+    assert in_spec["image"].shape == (12, 12, 3)
+    assert in_spec["image"].dtype == np.dtype(np.uint8)
+    # Non-image features unchanged.
+    assert in_spec["state"].shape == (4,)
+
+  def test_train_preprocess_crops_and_casts(self):
+    p = self.make()
+    batch = TensorSpecStruct()
+    batch.image = jnp.asarray(
+        np.random.default_rng(0).integers(0, 255, (2, 12, 12, 3),
+                                          dtype=np.uint8))
+    batch.state = jnp.ones((2, 4), jnp.float32)
+    out_f, _ = jax.jit(
+        lambda f: p.preprocess(f, None, Mode.TRAIN,
+                               jax.random.PRNGKey(0)))(batch)
+    assert out_f["image"].shape == (2, 8, 8, 3)
+    assert out_f["image"].dtype == jnp.float32
+    assert float(out_f["image"].max()) <= 1.0
+
+  def test_eval_is_deterministic_center_crop(self):
+    p = self.make()
+    image = np.zeros((1, 12, 12, 3), np.uint8)
+    image[0, 2:10, 2:10, :] = 255  # center block
+    batch = TensorSpecStruct({"image": jnp.asarray(image),
+                              "state": jnp.zeros((1, 4))})
+    out_f, _ = p.preprocess(batch, None, Mode.EVAL)
+    np.testing.assert_allclose(np.asarray(out_f["image"]), 1.0)
+
+
+class TestTPUCompatWrapper:
+
+  def test_cast_and_scale(self):
+    base = NoOpPreprocessor(
+        lambda mode: TensorSpecStruct(
+            {"img": ExtendedTensorSpec(shape=(4, 4, 3), dtype=np.uint8,
+                                       name="img")}),
+        lambda mode: None)
+    wrapper = TPUCompatPreprocessorWrapper(base, model_dtype=jnp.bfloat16)
+    out_spec = wrapper.get_out_feature_specification(Mode.TRAIN)
+    assert out_spec["img"].dtype == jnp.bfloat16.dtype
+    # In-spec still uint8 (cheap wire format).
+    in_spec = wrapper.get_in_feature_specification(Mode.TRAIN)
+    assert in_spec["img"].dtype == np.dtype(np.uint8)
+    batch = TensorSpecStruct(
+        {"img": jnp.full((2, 4, 4, 3), 255, jnp.uint8)})
+    out_f, _ = wrapper.preprocess(batch, None, Mode.TRAIN)
+    assert out_f["img"].dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out_f["img"].astype(jnp.float32)),
+                               1.0)
