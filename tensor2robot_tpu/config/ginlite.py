@@ -319,9 +319,13 @@ def _lookup_configurable(name: str) -> Optional[_Configurable]:
     if name in _REGISTRY.configurables:
       return _REGISTRY.configurables[name]
     # Partial module qualification, both directions: a registered
-    # 'module.fn' matches queries 'fn' and 'pkg.module.fn'.
+    # 'module.fn' matches queries 'fn' and 'pkg.module.fn'. The reverse
+    # direction requires the registered key to be module-qualified, so a
+    # foreign path like 'torch.xyz.fn' can never silently bind the bare
+    # registered 'fn'.
     matches = {id(c): c for n, c in _REGISTRY.configurables.items()
-               if n.endswith("." + name) or name.endswith("." + n)}
+               if n.endswith("." + name) or
+               ("." in n and name.endswith("." + n))}
     if len(matches) == 1:
       return next(iter(matches.values()))
     if len(matches) > 1:
@@ -428,7 +432,12 @@ def parse_value(text: str) -> Any:
 
 
 def _canonical_name(name: str, skip_unknown: bool = False) -> Optional[str]:
-  """Resolves a binding target to its registered bare name, or raises."""
+  """Resolves a binding target to its registered full name, or raises.
+
+  Bindings are keyed by the module-qualified full name — unique per
+  configurable — so two same-named configurables in different modules
+  never share a binding bucket.
+  """
   cfg = _lookup_configurable(name)
   if cfg is None:
     if skip_unknown:
@@ -437,7 +446,7 @@ def _canonical_name(name: str, skip_unknown: bool = False) -> Optional[str]:
         f"No configurable matching {name!r} is registered. Import the "
         f"defining module first (configs may use 'import a.b.c' lines), "
         f"or parse with skip_unknown=True.")
-  return cfg.name
+  return cfg.full_name
 
 
 def bind_parameter(binding_name: str, value: Any) -> None:

@@ -92,6 +92,8 @@ class TFRecordInputGenerator(AbstractInputGenerator):
       merged.update(label_spec.to_flat_dict())
     merged_struct = TensorSpecStruct.from_flat_dict(merged)
 
+    label_keys = set(label_spec.to_flat_dict()) if label_spec is not None \
+        else set()
     for serialized in ds.as_numpy_iterator():
       parsed = tfexample.parse_example_batch(serialized, merged_struct)
       flat = parsed.to_flat_dict()
@@ -99,8 +101,10 @@ class TFRecordInputGenerator(AbstractInputGenerator):
           {k: v for k, v in flat.items() if k in feature_keys})
       labels = None
       if label_spec is not None:
+        # Membership per spec, not set difference: a key declared in
+        # BOTH specs lands in both structs.
         labels = TensorSpecStruct.from_flat_dict(
-            {k: v for k, v in flat.items() if k not in feature_keys})
+            {k: v for k, v in flat.items() if k in label_keys})
       yield features, labels
 
 

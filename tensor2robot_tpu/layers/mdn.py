@@ -1,0 +1,92 @@
+"""Mixture-density-network head for continuous action policies.
+
+Reference parity: tensor2robot `layers/mdn.py` — the MDN output head
+used by vrgripper behavioral-cloning policies (SURVEY.md §3 "Network
+layers" row). The reference leaned on tensorflow_probability; here the
+diagonal-Gaussian mixture math is written directly in jnp (logsumexp),
+which XLA fuses into the surrounding network — no tfp dependency.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, NamedTuple, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+_LOG_2PI = math.log(2.0 * math.pi)
+
+
+class MDNParams(NamedTuple):
+  """Mixture parameters: shapes (..., K), (..., K, D), (..., K, D)."""
+
+  logits: jax.Array
+  means: jax.Array
+  log_scales: jax.Array
+
+
+class MDNHead(nn.Module):
+  """Projects features to mixture params over `output_size` dims."""
+
+  num_components: int
+  output_size: int
+  min_log_scale: float = -5.0
+  max_log_scale: float = 2.0
+  dtype: Any = jnp.float32
+
+  @nn.compact
+  def __call__(self, features: jax.Array) -> MDNParams:
+    k, d = self.num_components, self.output_size
+    raw = nn.Dense(k * (1 + 2 * d), dtype=self.dtype,
+                   name="mdn_proj")(features.astype(self.dtype))
+    raw = raw.astype(jnp.float32)
+    logits = raw[..., :k]
+    means = raw[..., k:k + k * d].reshape(*raw.shape[:-1], k, d)
+    log_scales = raw[..., k + k * d:].reshape(*raw.shape[:-1], k, d)
+    log_scales = jnp.clip(log_scales, self.min_log_scale,
+                          self.max_log_scale)
+    return MDNParams(logits, means, log_scales)
+
+
+def mdn_log_prob(params: MDNParams, targets: jax.Array) -> jax.Array:
+  """log p(targets) under the mixture; targets (..., D) -> (...)."""
+  t = targets[..., None, :]  # broadcast over components
+  inv_scales = jnp.exp(-params.log_scales)
+  z = (t - params.means) * inv_scales
+  comp_lp = -0.5 * jnp.sum(z * z + _LOG_2PI, axis=-1) - jnp.sum(
+      params.log_scales, axis=-1)
+  mix_lp = jax.nn.log_softmax(params.logits, axis=-1)
+  return jax.nn.logsumexp(mix_lp + comp_lp, axis=-1)
+
+
+def mdn_loss(params: MDNParams, targets: jax.Array) -> jax.Array:
+  """Mean negative log likelihood."""
+  return -jnp.mean(mdn_log_prob(params, targets))
+
+
+def mdn_mode(params: MDNParams) -> jax.Array:
+  """Mean of the most likely component — the standard greedy action."""
+  best = jnp.argmax(params.logits, axis=-1)
+  return jnp.take_along_axis(
+      params.means, best[..., None, None], axis=-2).squeeze(-2)
+
+
+def mdn_mean(params: MDNParams) -> jax.Array:
+  """Full mixture mean."""
+  weights = jax.nn.softmax(params.logits, axis=-1)
+  return jnp.sum(weights[..., None] * params.means, axis=-2)
+
+
+def mdn_sample(params: MDNParams, rng: jax.Array) -> jax.Array:
+  """Draws one sample per leading batch element."""
+  rng_k, rng_eps = jax.random.split(rng)
+  comp = jax.random.categorical(rng_k, params.logits, axis=-1)
+  means = jnp.take_along_axis(params.means, comp[..., None, None],
+                              axis=-2).squeeze(-2)
+  log_scales = jnp.take_along_axis(params.log_scales,
+                                   comp[..., None, None],
+                                   axis=-2).squeeze(-2)
+  eps = jax.random.normal(rng_eps, means.shape)
+  return means + jnp.exp(log_scales) * eps

@@ -1,0 +1,142 @@
+"""ResNet with optional FiLM conditioning.
+
+Reference parity: tensor2robot `layers/resnet.py` (+ film_resnet
+variant) — the backbone for grasp2vec embeddings and the larger
+grasping models (SURVEY.md §3 "Network layers" row).
+
+TPU-first: NHWC, bfloat16 activations / float32 params, static shapes.
+Standard pre-act-free torchvision-style v1 blocks; stage widths are
+multiples of 64 so every conv tiles the MXU.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tensor2robot_tpu.layers.vision_layers import FiLM
+
+
+class ResNetBlock(nn.Module):
+  """Basic 3x3+3x3 residual block (resnet-18/34 style)."""
+
+  filters: int
+  strides: Tuple[int, int] = (1, 1)
+  use_film: bool = False
+  dtype: Any = jnp.float32
+
+  @nn.compact
+  def __call__(self, x: jax.Array,
+               conditioning: Optional[jax.Array] = None,
+               train: bool = False) -> jax.Array:
+    norm = partial(nn.BatchNorm, use_running_average=not train,
+                   momentum=0.9, dtype=self.dtype)
+    residual = x
+    y = nn.Conv(self.filters, (3, 3), self.strides, padding="SAME",
+                use_bias=False, dtype=self.dtype, name="conv1")(x)
+    y = norm(name="bn1")(y)
+    y = nn.relu(y)
+    y = nn.Conv(self.filters, (3, 3), padding="SAME", use_bias=False,
+                dtype=self.dtype, name="conv2")(y)
+    y = norm(scale_init=nn.initializers.zeros, name="bn2")(y)
+    if self.use_film and conditioning is not None:
+      y = FiLM(dtype=self.dtype, name="film")(y, conditioning)
+    if residual.shape != y.shape:
+      residual = nn.Conv(self.filters, (1, 1), self.strides,
+                         use_bias=False, dtype=self.dtype,
+                         name="proj")(residual)
+      residual = norm(name="bn_proj")(residual)
+    return nn.relu(residual + y)
+
+
+class BottleneckBlock(nn.Module):
+  """1x1-3x3-1x1 bottleneck block (resnet-50 style)."""
+
+  filters: int
+  strides: Tuple[int, int] = (1, 1)
+  use_film: bool = False
+  dtype: Any = jnp.float32
+
+  @nn.compact
+  def __call__(self, x: jax.Array,
+               conditioning: Optional[jax.Array] = None,
+               train: bool = False) -> jax.Array:
+    norm = partial(nn.BatchNorm, use_running_average=not train,
+                   momentum=0.9, dtype=self.dtype)
+    residual = x
+    y = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=self.dtype,
+                name="conv1")(x)
+    y = nn.relu(norm(name="bn1")(y))
+    y = nn.Conv(self.filters, (3, 3), self.strides, padding="SAME",
+                use_bias=False, dtype=self.dtype, name="conv2")(y)
+    y = nn.relu(norm(name="bn2")(y))
+    y = nn.Conv(self.filters * 4, (1, 1), use_bias=False,
+                dtype=self.dtype, name="conv3")(y)
+    y = norm(scale_init=nn.initializers.zeros, name="bn3")(y)
+    if self.use_film and conditioning is not None:
+      y = FiLM(dtype=self.dtype, name="film")(y, conditioning)
+    if residual.shape != y.shape:
+      residual = nn.Conv(self.filters * 4, (1, 1), self.strides,
+                         use_bias=False, dtype=self.dtype,
+                         name="proj")(residual)
+      residual = norm(name="bn_proj")(residual)
+    return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+  """Configurable ResNet; `num_classes=None` returns pooled features.
+
+  `film_conditioning` (a (B, D) vector passed at call time) modulates
+  every block when `use_film=True` — the film_resnet variant used by
+  conditioned policies.
+  """
+
+  stage_sizes: Sequence[int] = (2, 2, 2, 2)
+  num_filters: int = 64
+  block_cls: Any = ResNetBlock
+  num_classes: Optional[int] = None
+  use_film: bool = False
+  dtype: Any = jnp.float32
+
+  @nn.compact
+  def __call__(self, images: jax.Array,
+               conditioning: Optional[jax.Array] = None,
+               train: bool = False) -> jax.Array:
+    x = images.astype(self.dtype)
+    x = nn.Conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                use_bias=False, dtype=self.dtype, name="conv_init")(x)
+    x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                     dtype=self.dtype, name="bn_init")(x)
+    x = nn.relu(x)
+    x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+    for i, block_count in enumerate(self.stage_sizes):
+      for j in range(block_count):
+        strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+        x = self.block_cls(
+            filters=self.num_filters * 2 ** i,
+            strides=strides,
+            use_film=self.use_film,
+            dtype=self.dtype,
+            name=f"stage{i}_block{j}",
+        )(x, conditioning=conditioning, train=train)
+    x = jnp.mean(x, axis=(1, 2))
+    if self.num_classes is not None:
+      x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+    return x.astype(jnp.float32)
+
+
+def resnet18(**kwargs) -> ResNet:
+  return ResNet(stage_sizes=(2, 2, 2, 2), block_cls=ResNetBlock, **kwargs)
+
+
+def resnet34(**kwargs) -> ResNet:
+  return ResNet(stage_sizes=(3, 4, 6, 3), block_cls=ResNetBlock, **kwargs)
+
+
+def resnet50(**kwargs) -> ResNet:
+  return ResNet(stage_sizes=(3, 4, 6, 3), block_cls=BottleneckBlock,
+                **kwargs)

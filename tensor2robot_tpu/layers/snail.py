@@ -1,0 +1,122 @@
+"""SNAIL building blocks: causal temporal convolutions + attention.
+
+Reference parity: tensor2robot `layers/snail.py` — the SNAIL
+(Mishra et al. 2017) temporal-convolution/attention blocks used by the
+meta-learning vrgripper policies (SURVEY.md §3 "Network layers" row).
+
+TPU-first: causal masking is a static lower-triangular mask (no dynamic
+shapes), dense blocks use dilated 1D convs which XLA lowers to MXU
+matmuls, attention is one fused softmax(QKᵀ)V — all static-shaped so a
+single compilation serves every step.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class CausalConv1D(nn.Module):
+  """Dilated causal 1D conv over (B, T, C) via left-padding."""
+
+  features: int
+  kernel_size: int = 2
+  dilation: int = 1
+  dtype: Any = jnp.float32
+
+  @nn.compact
+  def __call__(self, x: jax.Array) -> jax.Array:
+    pad = self.dilation * (self.kernel_size - 1)
+    x = jnp.pad(x, ((0, 0), (pad, 0), (0, 0)))
+    return nn.Conv(self.features, (self.kernel_size,),
+                   kernel_dilation=(self.dilation,), padding="VALID",
+                   dtype=self.dtype)(x)
+
+
+class DenseBlock(nn.Module):
+  """Gated activation causal conv whose output concats onto the input."""
+
+  filters: int
+  dilation: int
+  dtype: Any = jnp.float32
+
+  @nn.compact
+  def __call__(self, x: jax.Array) -> jax.Array:
+    xf = CausalConv1D(self.filters, dilation=self.dilation,
+                      dtype=self.dtype, name="filter")(x)
+    xg = CausalConv1D(self.filters, dilation=self.dilation,
+                      dtype=self.dtype, name="gate")(x)
+    activations = jnp.tanh(xf) * nn.sigmoid(xg)
+    return jnp.concatenate([x, activations], axis=-1)
+
+
+class TCBlock(nn.Module):
+  """Stack of DenseBlocks with dilations 1, 2, 4, ... covering seq_len."""
+
+  seq_len: int
+  filters: int
+  dtype: Any = jnp.float32
+
+  @nn.compact
+  def __call__(self, x: jax.Array) -> jax.Array:
+    num_layers = max(1, int(math.ceil(math.log2(max(self.seq_len, 2)))))
+    for i in range(num_layers):
+      x = DenseBlock(self.filters, dilation=2 ** i, dtype=self.dtype,
+                     name=f"dense_{i}")(x)
+    return x
+
+
+class AttentionBlock(nn.Module):
+  """Single-head causal attention whose output concats onto the input."""
+
+  key_size: int
+  value_size: int
+  dtype: Any = jnp.float32
+
+  @nn.compact
+  def __call__(self, x: jax.Array) -> jax.Array:
+    t = x.shape[1]
+    q = nn.Dense(self.key_size, dtype=self.dtype, name="query")(
+        x.astype(self.dtype))
+    k = nn.Dense(self.key_size, dtype=self.dtype, name="key")(
+        x.astype(self.dtype))
+    v = nn.Dense(self.value_size, dtype=self.dtype, name="value")(
+        x.astype(self.dtype))
+    logits = jnp.einsum("btk,bsk->bts", q, k) / math.sqrt(self.key_size)
+    mask = jnp.tril(jnp.ones((t, t), dtype=bool))
+    logits = jnp.where(mask[None], logits.astype(jnp.float32), -1e30)
+    weights = jax.nn.softmax(logits, axis=-1).astype(self.dtype)
+    out = jnp.einsum("bts,bsv->btv", weights, v)
+    return jnp.concatenate([x, out.astype(x.dtype)], axis=-1)
+
+
+class SNAIL(nn.Module):
+  """The canonical SNAIL trunk: attn -> TC -> attn -> TC -> attn -> proj."""
+
+  seq_len: int
+  filters: int = 32
+  key_size: int = 64
+  value_size: int = 32
+  output_size: Optional[int] = None
+  dtype: Any = jnp.float32
+
+  @nn.compact
+  def __call__(self, x: jax.Array) -> jax.Array:
+    x = AttentionBlock(self.key_size, self.value_size, dtype=self.dtype,
+                       name="attn_0")(x)
+    x = TCBlock(self.seq_len, self.filters, dtype=self.dtype,
+                name="tc_0")(x)
+    x = AttentionBlock(self.key_size, self.value_size, dtype=self.dtype,
+                       name="attn_1")(x)
+    x = TCBlock(self.seq_len, self.filters, dtype=self.dtype,
+                name="tc_1")(x)
+    x = AttentionBlock(self.key_size, self.value_size, dtype=self.dtype,
+                       name="attn_2")(x)
+    if self.output_size is not None:
+      x = nn.Dense(self.output_size, dtype=self.dtype, name="proj")(
+          x.astype(self.dtype))
+    return x.astype(jnp.float32)

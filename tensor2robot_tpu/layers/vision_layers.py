@@ -1,0 +1,149 @@
+"""Vision building blocks: conv towers, spatial softmax, FiLM.
+
+Reference parity: tensor2robot `layers/vision_layers.py` — the
+`BuildImagesToFeaturesModel`-style conv stacks used by the grasping /
+pose models, plus spatial-softmax keypoint pooling (SURVEY.md §3
+"Network layers" row; exact reference symbols tagged [U] there).
+
+TPU-first design notes:
+  * NHWC layout throughout — XLA's TPU conv emitter tiles NHWC convs
+    onto the MXU directly.
+  * `dtype` parameter everywhere: activations in bfloat16 on TPU while
+    params stay float32 (flax default behavior when dtype != param_dtype).
+  * Channel counts default to multiples of 8/128 so tensors tile the
+    8×128 VPU lanes and 128×128 MXU without padding waste.
+  * No python control flow on traced values; everything static-shaped.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class ConvTower(nn.Module):
+  """A VGG-ish stack of conv+norm+relu blocks with optional pooling.
+
+  The workhorse image encoder for grasping/pose models (reference's
+  images-to-features conv stacks).
+  """
+
+  filters: Sequence[int] = (32, 64, 128)
+  kernel_sizes: Optional[Sequence[int]] = None  # default 3 everywhere
+  strides: Optional[Sequence[int]] = None       # default 2 everywhere
+  use_batch_norm: bool = True
+  activation: Callable = nn.relu
+  dtype: Any = jnp.float32
+
+  @nn.compact
+  def __call__(self, images: jax.Array, train: bool = False) -> jax.Array:
+    x = images.astype(self.dtype)
+    kernels = self.kernel_sizes or (3,) * len(self.filters)
+    strides = self.strides or (2,) * len(self.filters)
+    for i, (f, k, s) in enumerate(zip(self.filters, kernels, strides)):
+      x = nn.Conv(f, (k, k), strides=(s, s), padding="SAME",
+                  use_bias=not self.use_batch_norm, dtype=self.dtype,
+                  name=f"conv_{i}")(x)
+      if self.use_batch_norm:
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         dtype=self.dtype, name=f"bn_{i}")(x)
+      x = self.activation(x)
+    return x
+
+
+def spatial_softmax(features: jax.Array,
+                    temperature: Optional[jax.Array] = None
+                    ) -> jax.Array:
+  """Soft-argmax keypoints: (B, H, W, C) -> (B, C*2) expected (x, y).
+
+  Reference parity: the spatial-softmax pooling used by the pose /
+  vrgripper encoders. Coordinates are in [-1, 1].
+  """
+  b, h, w, c = features.shape
+  # (B, H*W, C): softmax over spatial positions per channel.
+  logits = features.reshape(b, h * w, c).astype(jnp.float32)
+  if temperature is not None:
+    logits = logits / temperature
+  probs = jax.nn.softmax(logits, axis=1)
+  xs = jnp.linspace(-1.0, 1.0, w)
+  ys = jnp.linspace(-1.0, 1.0, h)
+  grid_x = jnp.tile(xs[None, :], (h, 1)).reshape(h * w)
+  grid_y = jnp.tile(ys[:, None], (1, w)).reshape(h * w)
+  exp_x = jnp.einsum("bpc,p->bc", probs, grid_x)
+  exp_y = jnp.einsum("bpc,p->bc", probs, grid_y)
+  return jnp.concatenate([exp_x, exp_y], axis=-1)
+
+
+class SpatialSoftmax(nn.Module):
+  """Module wrapper around `spatial_softmax` with a learnable temperature."""
+
+  learnable_temperature: bool = True
+
+  @nn.compact
+  def __call__(self, features: jax.Array) -> jax.Array:
+    if self.learnable_temperature:
+      log_temp = self.param("log_temperature", nn.initializers.zeros, ())
+      temperature = jnp.exp(log_temp)
+    else:
+      temperature = None
+    return spatial_softmax(features, temperature)
+
+
+class FiLM(nn.Module):
+  """Feature-wise linear modulation: x * (1 + gamma) + beta.
+
+  gamma/beta are projected from a conditioning vector; the (1 + gamma)
+  parameterization keeps the identity transform at init.
+  """
+
+  dtype: Any = jnp.float32
+
+  @nn.compact
+  def __call__(self, x: jax.Array, conditioning: jax.Array) -> jax.Array:
+    channels = x.shape[-1]
+    gb = nn.Dense(2 * channels, dtype=self.dtype, name="film_proj")(
+        conditioning.astype(self.dtype))
+    gamma, beta = jnp.split(gb, 2, axis=-1)
+    # Broadcast (B, C) over spatial dims of (B, H, W, C) / (B, T, C).
+    while gamma.ndim < x.ndim:
+      gamma = gamma[:, None]
+      beta = beta[:, None]
+    return x * (1.0 + gamma) + beta
+
+
+class ImageEncoder(nn.Module):
+  """ConvTower -> {spatial_softmax | global pool | flatten} -> embedding.
+
+  One-stop image-to-vector encoder matching the common reference pattern
+  of conv stack + pooling + dense projection.
+  """
+
+  filters: Sequence[int] = (32, 64, 128)
+  embedding_size: int = 128
+  pooling: str = "spatial_softmax"  # | "mean" | "flatten"
+  use_batch_norm: bool = True
+  film: bool = False
+  dtype: Any = jnp.float32
+
+  @nn.compact
+  def __call__(self, images: jax.Array,
+               conditioning: Optional[jax.Array] = None,
+               train: bool = False) -> jax.Array:
+    x = ConvTower(filters=self.filters, use_batch_norm=self.use_batch_norm,
+                  dtype=self.dtype, name="tower")(images, train=train)
+    if self.film and conditioning is not None:
+      x = FiLM(dtype=self.dtype, name="film")(x, conditioning)
+    if self.pooling == "spatial_softmax":
+      x = SpatialSoftmax(name="ssoftmax")(x)
+    elif self.pooling == "mean":
+      x = jnp.mean(x, axis=(1, 2))
+    elif self.pooling == "flatten":
+      x = x.reshape(x.shape[0], -1)
+    else:
+      raise ValueError(f"Unknown pooling: {self.pooling}")
+    x = nn.Dense(self.embedding_size, dtype=self.dtype,
+                 name="proj")(x.astype(self.dtype))
+    return x.astype(jnp.float32)

@@ -179,8 +179,11 @@ class AbstractT2RModel(ModelInterface):
     seeds parsers and tests.
     """
     out_spec = self.preprocessor.get_out_feature_specification(Mode.TRAIN)
+    # include_optional=False: input generators exclude optional specs
+    # from real batches, so init must see the same tree structure or the
+    # first jitted step diverges from the initialized params.
     dummy = specs_lib.make_random_tensors(
-        out_spec, batch_size=batch_size, seed=0)
+        out_spec, batch_size=batch_size, seed=0, include_optional=False)
     dummy = jax.tree_util.tree_map(jnp.asarray, dummy)
     init_rng, dropout_rng = jax.random.split(rng)
     variables = self.network.init(

@@ -111,8 +111,14 @@ class ImagePreprocessor(AbstractPreprocessor):
             if (images.shape[-3], images.shape[-2]) != (th, tw) \
             else images
         if self._distort:
+          distort_kwargs = dict(self._distort_kwargs)
+          if images.shape[-1] != 3:
+            # Hue rotation / saturation blending are RGB-only; grayscale
+            # or depth channels keep brightness/contrast/noise.
+            distort_kwargs["max_hue_delta"] = 0.0
+            distort_kwargs["saturation_range"] = None
           images = imt.apply_photometric_image_distortions(
-              distort_key, images, **self._distort_kwargs)
+              distort_key, images, **distort_kwargs)
       else:
         if (images.shape[-3], images.shape[-2]) != (th, tw):
           images = imt.center_crop(images, th, tw)

@@ -168,6 +168,7 @@ def train_eval_model(
 
   step = int(np.asarray(jax.device_get(state.step)))
   final_metrics: Dict[str, Any] = {}
+  train_prefetcher = None
   try:
     if input_generator_train is not None and step < max_train_steps:
       stream = input_generator_train.create_dataset(
@@ -211,7 +212,6 @@ def train_eval_model(
               eval_steps, eval_batch_size or batch_size)
           metric_logger.write("eval", step, eval_metrics)
 
-      train_prefetcher.close()
       # Final checkpoint if the loop ended off-interval.
       if last_saved_step != step:
         writer.save(step, jax.device_get(state))
@@ -232,6 +232,10 @@ def train_eval_model(
 
     hook_list.end(step, state, model_dir)
   finally:
+    # Close in finally: an exception mid-training must not leak the
+    # prefetch worker (it pins buffered sharded batches in HBM).
+    if train_prefetcher is not None:
+      train_prefetcher.close()
     writer.close()
     metric_logger.close()
   return state

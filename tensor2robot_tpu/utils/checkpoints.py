@@ -33,22 +33,27 @@ def _ckpt_root(model_dir: str) -> str:
   return os.path.join(model_dir, CKPT_SUBDIR)
 
 
-def list_steps(model_dir: str) -> List[int]:
+def list_steps(model_dir: str, subdir: str = "state") -> List[int]:
+  """Lists steps whose `subdir` payload has been finalized.
+
+  state/ and params/ are written by independent async checkpointers
+  (each with its own atomic rename), so a step only counts once the
+  SPECIFIC payload the caller intends to restore exists — otherwise a
+  poller could pick up a step whose other half finalized first.
+  """
   root = _ckpt_root(model_dir)
   if not os.path.isdir(root):
     return []
   steps = []
   for entry in os.listdir(root):
     if re.fullmatch(r"\d+", entry) and not entry.endswith(".tmp"):
-      # Only finalized orbax dirs (atomic rename) contain state/.
-      if os.path.isdir(os.path.join(root, entry, "state")) or \
-          os.path.isdir(os.path.join(root, entry, "params")):
+      if os.path.isdir(os.path.join(root, entry, subdir)):
         steps.append(int(entry))
   return sorted(steps)
 
 
-def latest_step(model_dir: str) -> Optional[int]:
-  steps = list_steps(model_dir)
+def latest_step(model_dir: str, subdir: str = "state") -> Optional[int]:
+  steps = list_steps(model_dir, subdir)
   return steps[-1] if steps else None
 
 
@@ -151,7 +156,7 @@ def restore_params(path_or_model_dir: str, like: Any,
     candidates.append(os.path.join(
         _ckpt_root(path_or_model_dir), str(int(step)), "params"))
   else:
-    found = latest_step(path_or_model_dir)
+    found = latest_step(path_or_model_dir, subdir="params")
     if found is not None:
       candidates.append(os.path.join(
           _ckpt_root(path_or_model_dir), str(found), "params"))

@@ -1,0 +1,202 @@
+"""Tests for network building blocks (vision, resnet, mdn, snail)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensor2robot_tpu import layers
+
+
+RNG = jax.random.PRNGKey(0)
+
+
+def _init_apply(module, *args, train=False, **kwargs):
+  variables = module.init({"params": RNG, "dropout": RNG}, *args,
+                          train=train, **kwargs) if _wants_train(module) \
+      else module.init({"params": RNG}, *args, **kwargs)
+  if _wants_train(module):
+    out = module.apply(variables, *args, train=train, **kwargs,
+                       mutable=["batch_stats"] if train else False)
+    return out[0] if train else out
+  return module.apply(variables, *args, **kwargs)
+
+
+def _wants_train(module):
+  import inspect
+  return "train" in inspect.signature(module.__call__).parameters
+
+
+class TestVisionLayers:
+
+  def test_conv_tower_shapes(self):
+    images = jnp.zeros((2, 64, 64, 3))
+    out = _init_apply(layers.ConvTower(filters=(8, 16, 32)), images)
+    assert out.shape == (2, 8, 8, 32)
+
+  def test_conv_tower_no_bn(self):
+    images = jnp.zeros((2, 32, 32, 3))
+    out = _init_apply(layers.ConvTower(filters=(8,), use_batch_norm=False),
+                      images)
+    assert out.shape == (2, 16, 16, 8)
+
+  def test_spatial_softmax_peak(self):
+    # A delta at (row 2, col 5) in an 8x8 map -> expected coords near
+    # the normalized grid position of that cell.
+    fmap = np.full((1, 8, 8, 1), -1e9, np.float32)
+    fmap[0, 2, 5, 0] = 1e9
+    out = layers.spatial_softmax(jnp.asarray(fmap))
+    x, y = float(out[0, 0]), float(out[0, 1])
+    assert np.isclose(x, -1 + 2 * 5 / 7, atol=1e-3)
+    assert np.isclose(y, -1 + 2 * 2 / 7, atol=1e-3)
+
+  def test_spatial_softmax_module(self):
+    fmap = jnp.ones((2, 4, 4, 6))
+    out = _init_apply(layers.SpatialSoftmax(), fmap)
+    assert out.shape == (2, 12)
+
+  def test_film_identity_at_init(self):
+    x = jax.random.normal(RNG, (2, 4, 4, 8))
+    cond = jnp.zeros((2, 3))
+    film = layers.FiLM()
+    variables = film.init(RNG, x, cond)
+    # Zero-init dense -> gamma=beta=0 -> identity.
+    out = film.apply(variables, x, cond)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), atol=1e-6)
+
+  @pytest.mark.parametrize("pooling", ["spatial_softmax", "mean", "flatten"])
+  def test_image_encoder(self, pooling):
+    images = jnp.zeros((2, 32, 32, 3))
+    enc = layers.ImageEncoder(filters=(8, 16), embedding_size=24,
+                              pooling=pooling)
+    out = _init_apply(enc, images)
+    assert out.shape == (2, 24)
+    assert out.dtype == jnp.float32
+
+  def test_image_encoder_film(self):
+    images = jnp.zeros((2, 32, 32, 3))
+    cond = jnp.ones((2, 5))
+    enc = layers.ImageEncoder(filters=(8,), embedding_size=16, film=True)
+    variables = enc.init(RNG, images, conditioning=cond, train=False)
+    out = enc.apply(variables, images, conditioning=cond, train=False)
+    assert out.shape == (2, 16)
+
+
+class TestResNet:
+
+  def test_resnet18_features(self):
+    images = jnp.zeros((2, 64, 64, 3))
+    net = layers.resnet18(num_filters=8)
+    out = _init_apply(net, images)
+    assert out.shape == (2, 64)  # 8 * 2**3
+
+  def test_resnet18_classes(self):
+    images = jnp.zeros((2, 64, 64, 3))
+    net = layers.resnet18(num_filters=8, num_classes=10)
+    out = _init_apply(net, images)
+    assert out.shape == (2, 10)
+
+  def test_resnet50_bottleneck(self):
+    images = jnp.zeros((1, 64, 64, 3))
+    net = layers.ResNet(stage_sizes=(1, 1, 1, 1),
+                        block_cls=layers.BottleneckBlock, num_filters=8)
+    out = _init_apply(net, images)
+    assert out.shape == (1, 8 * 2 ** 3 * 4)
+
+  def test_film_resnet(self):
+    images = jnp.zeros((2, 64, 64, 3))
+    cond = jnp.ones((2, 7))
+    net = layers.ResNet(stage_sizes=(1, 1), num_filters=8, use_film=True)
+    variables = net.init(RNG, images, conditioning=cond, train=False)
+    out = net.apply(variables, images, conditioning=cond, train=False)
+    assert out.shape == (2, 16)
+
+  def test_train_mode_updates_batch_stats(self):
+    images = jax.random.normal(RNG, (2, 32, 32, 3))
+    net = layers.resnet18(num_filters=8)
+    variables = net.init(RNG, images, train=False)
+    _, updates = net.apply(variables, images, train=True,
+                           mutable=["batch_stats"])
+    assert "batch_stats" in updates
+
+
+class TestMDN:
+
+  def _params(self, batch=4, k=3, d=2):
+    head = layers.MDNHead(num_components=k, output_size=d)
+    feats = jax.random.normal(RNG, (batch, 16))
+    variables = head.init(RNG, feats)
+    return head.apply(variables, feats)
+
+  def test_head_shapes(self):
+    params = self._params(batch=4, k=3, d=2)
+    assert params.logits.shape == (4, 3)
+    assert params.means.shape == (4, 3, 2)
+    assert params.log_scales.shape == (4, 3, 2)
+
+  def test_log_prob_matches_single_gaussian(self):
+    # One component -> plain diagonal Gaussian log prob.
+    logits = jnp.zeros((2, 1))
+    means = jnp.zeros((2, 1, 3))
+    log_scales = jnp.zeros((2, 1, 3))
+    params = layers.MDNParams(logits, means, log_scales)
+    targets = jnp.zeros((2, 3))
+    lp = layers.mdn_log_prob(params, targets)
+    expected = -0.5 * 3 * np.log(2 * np.pi)
+    np.testing.assert_allclose(np.asarray(lp), expected, rtol=1e-5)
+
+  def test_loss_decreases_toward_target(self):
+    params = self._params()
+    t_at_mean = layers.mdn_mode(params)
+    t_far = t_at_mean + 100.0
+    assert float(layers.mdn_loss(params, t_at_mean)) < float(
+        layers.mdn_loss(params, t_far))
+
+  def test_mode_mean_sample_shapes(self):
+    params = self._params(batch=5, k=4, d=3)
+    assert layers.mdn_mode(params).shape == (5, 3)
+    assert layers.mdn_mean(params).shape == (5, 3)
+    assert layers.mdn_sample(params, RNG).shape == (5, 3)
+
+  def test_mixture_mean_weighted(self):
+    logits = jnp.log(jnp.asarray([[0.25, 0.75]]))
+    means = jnp.asarray([[[0.0], [4.0]]])
+    params = layers.MDNParams(logits, means, jnp.zeros((1, 2, 1)))
+    np.testing.assert_allclose(np.asarray(layers.mdn_mean(params)),
+                               [[3.0]], rtol=1e-5)
+
+
+class TestSNAIL:
+
+  def test_causal_conv_shapes(self):
+    x = jnp.zeros((2, 10, 4))
+    conv = layers.CausalConv1D(8, dilation=2)
+    variables = conv.init(RNG, x)
+    assert conv.apply(variables, x).shape == (2, 10, 8)
+
+  def test_causality(self):
+    # Changing the future must not change the past output.
+    x1 = jax.random.normal(RNG, (1, 8, 4))
+    x2 = x1.at[0, 5:].set(99.0)
+    snail = layers.SNAIL(seq_len=8, filters=4, key_size=8, value_size=4,
+                         output_size=3)
+    variables = snail.init(RNG, x1)
+    o1 = snail.apply(variables, x1)
+    o2 = snail.apply(variables, x2)
+    np.testing.assert_allclose(np.asarray(o1[0, :5]),
+                               np.asarray(o2[0, :5]), atol=1e-5)
+
+  def test_tc_block_growth(self):
+    x = jnp.zeros((2, 16, 4))
+    tc = layers.TCBlock(seq_len=16, filters=8)
+    variables = tc.init(RNG, x)
+    out = tc.apply(variables, x)
+    # ceil(log2(16)) = 4 dense blocks, each adds 8 channels.
+    assert out.shape == (2, 16, 4 + 4 * 8)
+
+  def test_snail_output(self):
+    x = jnp.zeros((2, 6, 5))
+    snail = layers.SNAIL(seq_len=6, filters=4, key_size=8, value_size=4,
+                         output_size=7)
+    variables = snail.init(RNG, x)
+    assert snail.apply(variables, x).shape == (2, 6, 7)
