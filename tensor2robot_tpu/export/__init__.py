@@ -1,0 +1,11 @@
+"""Export generators (reference: tensor2robot export_generators/)."""
+
+from tensor2robot_tpu.export.abstract_export_generator import (
+    AbstractExportGenerator,
+    claim_timestamped_export_dir,
+    latest_export_dir,
+)
+from tensor2robot_tpu.export.savedmodel_export_generator import (
+    SavedModelExportGenerator,
+    create_default_exporters,
+)

@@ -1,0 +1,75 @@
+"""Export generator protocol: trained state → serving artifact.
+
+Reference parity: tensor2robot `export_generators/
+abstract_export_generator.py` — `AbstractExportGenerator` building
+serving_input_receiver_fns from specs and exporting SavedModels with t2r
+assets (SURVEY.md §3 "Export generators"; file:line unavailable — empty
+reference mount).
+
+TPU-native redesign: no receiver fns / sessions. An exporter takes the
+model and its on-device TrainState and writes a self-describing artifact
+(SavedModel via jax2tf, or a raw orbax params dir) whose spec assets let
+predictors rebuild the serving contract without the model class.
+"""
+
+from __future__ import annotations
+
+import abc
+import os
+import time
+from typing import Any, Optional
+
+
+def claim_timestamped_export_dir(export_dir_base: str) -> tuple:
+  """Atomically claims `<base>/<unix_ts>`; returns (final_dir, tmp_dir).
+
+  Estimator-style monotonic timestamp dirs so pollers pick `max()`.
+  The claim is the mkdir of `<ts>.tmp` (atomic on POSIX): concurrent
+  exporters — e.g. the async-export hook's thread racing the end-of-
+  training exporter within the same second — get distinct timestamps
+  instead of colliding inside one half-written artifact. The caller
+  writes into tmp_dir and publishes with os.rename(tmp_dir, final_dir).
+  """
+  os.makedirs(export_dir_base, exist_ok=True)
+  ts = int(time.time())
+  while True:
+    path = os.path.join(export_dir_base, str(ts))
+    tmp = path + ".tmp"
+    if not os.path.exists(path):
+      try:
+        os.mkdir(tmp)
+        return path, tmp
+      except FileExistsError:
+        pass
+    ts += 1
+
+
+def latest_export_dir(export_dir_base: str) -> Optional[str]:
+  """Largest finalized timestamped subdir, or None."""
+  if not os.path.isdir(export_dir_base):
+    return None
+  candidates = [d for d in os.listdir(export_dir_base)
+                if d.isdigit()
+                and not d.endswith(".tmp")
+                and os.path.isdir(os.path.join(export_dir_base, d))]
+  if not candidates:
+    return None
+  return os.path.join(export_dir_base, max(candidates, key=int))
+
+
+class AbstractExportGenerator(abc.ABC):
+  """Builds serving artifacts from a model + TrainState."""
+
+  def __init__(self, export_dir_base: Optional[str] = None):
+    self._export_dir_base = export_dir_base
+
+  def export_dir_base(self, model_dir: str) -> str:
+    return self._export_dir_base or os.path.join(model_dir, "export")
+
+  def set_export_dir_base(self, export_dir_base: str) -> None:
+    """Public override point (used by e.g. AsyncExportHook)."""
+    self._export_dir_base = export_dir_base
+
+  @abc.abstractmethod
+  def export(self, model: Any, state: Any, model_dir: str) -> str:
+    """Writes one serving artifact; returns its path."""

@@ -1,0 +1,165 @@
+"""SavedModel exporter: jax2tf predict path + t2r spec assets.
+
+Reference parity: tensor2robot `export_generators/
+default_export_generator.py` — SavedModel export with raw-numpy and
+tf.Example serving signatures, plus `assets.extra/t2r_assets` so
+robot-side predictors can rebuild the serving specs (SURVEY.md §3, §4.4;
+file:line unavailable — empty reference mount).
+
+TPU-native redesign: the model's pure `predict_step` (preprocess +
+network, already one XLA program) is closed over the trained params and
+staged to TF with `jax2tf.convert`. Two signatures:
+  * `serving_default` — one named tf tensor per flat feature-spec key
+    (the reference's numpy receiver).
+  * `parse_tf_example` — a batch of serialized tf.Example protos; the
+    spec-derived parse graph (same derivation as the training-side
+    TFExampleDecoder) runs in TF, then feeds the converted XLA fn.
+Spec assets land in `assets.extra/t2r_assets.json` inside the
+SavedModel, exactly where reference consumers look for them.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from tensor2robot_tpu import config as gin
+from tensor2robot_tpu import specs as specs_lib
+from tensor2robot_tpu.data import tfexample
+from tensor2robot_tpu.data.abstract_input_generator import Mode
+from tensor2robot_tpu.export.abstract_export_generator import (
+    AbstractExportGenerator,
+    claim_timestamped_export_dir,
+)
+
+
+def _tf():
+  import tensorflow as tf  # lazy, host-side only
+  return tf
+
+
+@gin.configurable
+class SavedModelExportGenerator(AbstractExportGenerator):
+  """Exports predict_step as a TF SavedModel with spec assets."""
+
+  def __init__(self,
+               export_dir_base: Optional[str] = None,
+               include_tf_example_signature: bool = True,
+               batch_polymorphic: bool = True):
+    super().__init__(export_dir_base)
+    self._include_tf_example_signature = include_tf_example_signature
+    self._batch_polymorphic = batch_polymorphic
+
+  def export(self, model: Any, state: Any, model_dir: str) -> str:
+    from jax.experimental import jax2tf  # lazy: TF import is slow
+    tf = _tf()
+
+    feature_spec = specs_lib.flatten_spec_structure(
+        model.preprocessor.get_in_feature_specification(Mode.PREDICT))
+    flat_specs = feature_spec.to_flat_dict()
+    # Serving state must be host-local numpy: the SavedModel must not
+    # capture device buffers (the trainer's state lives on the mesh).
+    variables = jax.device_get(state.variables)
+    state_step = int(np.asarray(jax.device_get(state.step)))
+
+    def predict_flat(flat_features: Dict[str, Any]):
+      features = specs_lib.TensorSpecStruct.from_flat_dict(
+          dict(flat_features))
+      frozen = type(state)(
+          step=state_step, params=variables["params"],
+          batch_stats=variables.get("batch_stats", {}),
+          opt_state=None)
+      outputs = model.predict_step(frozen, features)
+      if not isinstance(outputs, (dict, specs_lib.TensorSpecStruct)):
+        outputs = {"output": outputs}
+      if isinstance(outputs, specs_lib.TensorSpecStruct):
+        outputs = outputs.to_flat_dict()
+      return dict(outputs)
+
+    batch_dim = None if self._batch_polymorphic else 1
+    poly = "(b, ...)" if self._batch_polymorphic else None
+    converted = jax2tf.convert(
+        predict_flat,
+        polymorphic_shapes=[{k: poly for k in flat_specs}]
+        if self._batch_polymorphic else None,
+        # Robots deserve a model that runs wherever they are: lower for
+        # CPU and TPU regardless of which backend the trainer ran on.
+        native_serialization_platforms=("cpu", "tpu"),
+        with_gradient=False)
+
+    tf_module = tf.Module()
+
+    # Signature tensor names cannot contain '/', so nested flat keys
+    # (a/b/c) are sanitized; predictors apply the same mapping.
+    input_sigs = {
+        key: tf.TensorSpec([batch_dim] + list(spec.shape),
+                           _tf_dtype(tf, spec),
+                           name=key.replace("/", "_"))
+        for key, spec in flat_specs.items()
+    }
+
+    @tf.function(input_signature=[input_sigs])
+    def serving_default(flat_features):
+      return converted(flat_features)
+
+    signatures = {"serving_default": serving_default}
+
+    if self._include_tf_example_signature:
+      feature_map = tfexample.build_feature_map(feature_spec)
+
+      @tf.function(input_signature=[
+          tf.TensorSpec([batch_dim], tf.string, name="examples")])
+      def parse_tf_example(serialized):
+        parsed = tf.io.parse_example(serialized, feature_map)
+        flat = {}
+        for key, spec in flat_specs.items():
+          wire = tfexample.wire_key(key, spec)
+          value = parsed[wire]
+          if isinstance(value, tf.sparse.SparseTensor):
+            value = tf.sparse.to_dense(value)
+          if spec.is_image and value.dtype == tf.string:
+            value = tf.map_fn(
+                lambda b: tf.io.decode_image(
+                    b, channels=spec.shape[-1], expand_animations=False),
+                value, fn_output_signature=tf.uint8)
+          value = tf.reshape(
+              value, [-1] + list(spec.shape))
+          flat[key] = tf.cast(value, _tf_dtype(tf, spec))
+        return converted(flat)
+
+      signatures["parse_tf_example"] = parse_tf_example
+
+    export_base = self.export_dir_base(model_dir)
+    export_dir, tmp_dir = claim_timestamped_export_dir(export_base)
+    tf.saved_model.save(tf_module, tmp_dir, signatures=signatures)
+
+    assets_dir = os.path.join(tmp_dir, "assets.extra")
+    os.makedirs(assets_dir, exist_ok=True)
+    specs_lib.write_assets(
+        os.path.join(assets_dir, specs_lib.ASSET_FILENAME),
+        feature_spec,
+        label_spec=model.preprocessor.get_in_label_specification(
+            Mode.PREDICT),
+        global_step=state_step)
+    # Atomic publish: pollers never observe a half-written SavedModel.
+    os.rename(tmp_dir, export_dir)
+    return export_dir
+
+
+def _tf_dtype(tf, spec):
+  name = ("bfloat16" if str(spec.dtype) == "bfloat16"
+          else np.dtype(spec.dtype).name)
+  return getattr(tf, name)
+
+
+@gin.configurable
+def create_default_exporters(model,
+                             export_dir_base: Optional[str] = None,
+                             **kwargs):
+  """Reference-parity factory for train_eval's create_exporters_fn."""
+  del model
+  return [SavedModelExportGenerator(export_dir_base=export_dir_base,
+                                    **kwargs)]

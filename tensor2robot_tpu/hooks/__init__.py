@@ -1,3 +1,4 @@
 """Trainer hooks (reference: tensor2robot hooks/ SessionRunHook builders)."""
 
 from tensor2robot_tpu.hooks.hook import Hook, HookList
+from tensor2robot_tpu.hooks.async_export_hook import AsyncExportHook

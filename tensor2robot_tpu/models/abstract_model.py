@@ -170,13 +170,14 @@ class AbstractT2RModel(ModelInterface):
 
   # ---- state ----
 
-  def create_train_state(self, rng: jax.Array,
-                         batch_size: int = 1) -> TrainState:
-    """Initializes params (+ batch stats + optimizer state) from specs.
+  def create_inference_state(self, rng: jax.Array,
+                             batch_size: int = 1) -> TrainState:
+    """Initializes network variables only — no optimizer state.
 
     The dummy init batch is derived mechanically from the preprocessor's
     OUT specs — the spec system seeding initialization the same way it
-    seeds parsers and tests.
+    seeds parsers and tests. Predictors use this directly: serving never
+    needs (or pays the memory for) optimizer moments.
     """
     out_spec = self.preprocessor.get_out_feature_specification(Mode.TRAIN)
     # include_optional=False: input generators exclude optional specs
@@ -192,13 +193,18 @@ class AbstractT2RModel(ModelInterface):
     batch_stats = variables.get("batch_stats", {})
     if self._init_from_checkpoint_path:
       params = self.maybe_init_from_checkpoint(params)
-    state = TrainState(
+    return TrainState(
         step=jnp.zeros((), jnp.int32),
         params=params,
         batch_stats=batch_stats,
-        opt_state=self.tx.init(params),
+        opt_state=None,
     )
-    return state
+
+  def create_train_state(self, rng: jax.Array,
+                         batch_size: int = 1) -> TrainState:
+    """Initializes params + batch stats + optimizer state from specs."""
+    state = self.create_inference_state(rng, batch_size=batch_size)
+    return state.replace(opt_state=self.tx.init(state.params))
 
   def maybe_init_from_checkpoint(self, params):
     """Warm-starts params from `init_from_checkpoint_path` (orbax)."""

@@ -1,0 +1,11 @@
+"""Predictors: on-robot inference (reference: tensor2robot predictors/)."""
+
+from tensor2robot_tpu.predictors.abstract_predictor import (
+    AbstractPredictor,
+)
+from tensor2robot_tpu.predictors.checkpoint_predictor import (
+    CheckpointPredictor,
+)
+from tensor2robot_tpu.predictors.saved_model_predictor import (
+    SavedModelPredictor,
+)

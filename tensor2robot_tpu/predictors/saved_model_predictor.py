@@ -1,0 +1,115 @@
+"""SavedModel-backed predictor: specs rebuilt from exported t2r assets.
+
+Reference parity: tensor2robot `predictors/
+exported_savedmodel_predictor.py` — load the newest SavedModel from an
+export dir (polling with timeout), rebuild ExtendedTensorSpecs from the
+t2r assets shipped inside it, and serve `predict` (SURVEY.md §3, §4.4;
+file:line unavailable — empty reference mount).
+
+This is the robot-fleet handoff consumer: it needs NO model class, only
+the export directory the trainer's async-export hook publishes into.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from tensor2robot_tpu import config as gin
+from tensor2robot_tpu import specs as specs_lib
+from tensor2robot_tpu.export.abstract_export_generator import (
+    latest_export_dir,
+)
+from tensor2robot_tpu.predictors.abstract_predictor import (
+    AbstractPredictor,
+)
+from tensor2robot_tpu.specs import TensorSpecStruct
+
+
+@gin.configurable
+class SavedModelPredictor(AbstractPredictor):
+  """Serves the newest SavedModel under `export_dir_base`."""
+
+  def __init__(self, export_dir_base: str,
+               signature: str = "serving_default"):
+    self._export_dir_base = export_dir_base
+    self._signature = signature
+    self._loaded = None
+    self._serving_fn = None
+    self._feature_spec: Optional[TensorSpecStruct] = None
+    self._label_spec: Optional[TensorSpecStruct] = None
+    self._version = -1
+    self._global_step = -1
+
+  @property
+  def feature_specification(self) -> TensorSpecStruct:
+    self.assert_is_loaded()
+    return self._feature_spec
+
+  @property
+  def label_specification(self):
+    return self._label_spec
+
+  @property
+  def model_version(self) -> int:
+    return self._version
+
+  @property
+  def global_step(self) -> int:
+    return self._global_step
+
+  def restore(self, timeout_secs: Optional[float] = None,
+              poll_interval_secs: float = 1.0) -> bool:
+    """Loads an export NEWER than the currently loaded one.
+
+    `timeout_secs=None` blocks until one appears (the
+    AbstractPredictor contract, matching CheckpointPredictor /
+    wait_for_new_checkpoint). On timeout, returns whether the
+    predictor is serviceable (some version already loaded).
+    """
+    deadline = (time.time() + timeout_secs) if timeout_secs is not None \
+        else None
+    while True:
+      path = latest_export_dir(self._export_dir_base)
+      if path is not None:
+        version = int(os.path.basename(path))
+        if version > self._version:
+          self._load(path, version)
+          return True
+      if deadline is not None and time.time() >= deadline:
+        return self._version >= 0
+      time.sleep(poll_interval_secs)
+
+  def _load(self, path: str, version: int) -> None:
+    import tensorflow as tf  # lazy
+
+    loaded = tf.saved_model.load(path)
+    self._serving_fn = loaded.signatures[self._signature]
+    self._loaded = loaded  # keep alive: signatures hold weakrefs
+    assets = specs_lib.read_assets(
+        os.path.join(path, "assets.extra", specs_lib.ASSET_FILENAME))
+    self._feature_spec = assets["feature_spec"]
+    self._label_spec = assets.get("label_spec")
+    self._global_step = assets.get("global_step", -1)
+    self._version = version
+
+  def predict(self, features: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    import tensorflow as tf  # lazy
+
+    self.assert_is_loaded()
+    packed = self._validate(features)
+    flat = packed.to_flat_dict() if isinstance(packed, TensorSpecStruct) \
+        else dict(packed)
+    # Signature inputs are flat keys; TF rejects '/' in arg names, so
+    # exported signatures use the sanitized form.
+    feed = {_sanitize(k): tf.convert_to_tensor(np.asarray(v))
+            for k, v in flat.items()}
+    outputs = self._serving_fn(**feed)
+    return {k: v.numpy() for k, v in outputs.items()}
+
+
+def _sanitize(key: str) -> str:
+  return key.replace("/", "_")

@@ -175,16 +175,19 @@ def wait_for_new_checkpoint(
     last_step: Optional[int] = None,
     timeout_secs: Optional[float] = None,
     poll_interval_secs: float = 1.0,
+    subdir: str = "state",
 ) -> Optional[int]:
   """Blocks until a checkpoint newer than `last_step` appears.
 
   Reference parity: predictors' poll/wait for new checkpoints
   (SURVEY.md §4.4). Returns the new step, or None on timeout.
+  `subdir` selects which payload must be finalized ("params" for
+  predictors that only restore parameters).
   """
   deadline = (time.time() + timeout_secs) if timeout_secs is not None \
       else None
   while True:
-    step = latest_step(model_dir)
+    step = latest_step(model_dir, subdir=subdir)
     if step is not None and (last_step is None or step > last_step):
       return step
     if deadline is not None and time.time() > deadline:

@@ -1,0 +1,159 @@
+"""Tests for the serving path: export generators, predictors, async hook.
+
+Covers the reference's robot-fleet handoff contract (SURVEY.md §4.4):
+trainer exports SavedModels with spec assets; robot-side predictors
+rebuild specs from assets and serve numpy predict without the model
+class.
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from tensor2robot_tpu import specs
+from tensor2robot_tpu.data.abstract_input_generator import Mode
+from tensor2robot_tpu.data.random_input_generator import (
+    RandomInputGenerator,
+)
+from tensor2robot_tpu.export import (
+    SavedModelExportGenerator,
+    latest_export_dir,
+)
+from tensor2robot_tpu.hooks import AsyncExportHook
+from tensor2robot_tpu.predictors import (
+    CheckpointPredictor,
+    SavedModelPredictor,
+)
+from tensor2robot_tpu import train_eval
+from tensor2robot_tpu.utils.mocks import MockT2RModel
+
+
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory):
+  """One short training run shared by the serving tests."""
+  model_dir = str(tmp_path_factory.mktemp("served_model"))
+  model = MockT2RModel()
+  state = train_eval.train_eval_model(
+      model=model,
+      model_dir=model_dir,
+      input_generator_train=RandomInputGenerator(batch_size=8),
+      max_train_steps=4,
+      save_checkpoints_steps=2,
+      log_every_steps=2,
+  )
+  return model, state, model_dir
+
+
+class TestSavedModelExport:
+
+  def test_export_creates_artifact_with_assets(self, trained):
+    model, state, model_dir = trained
+    gen = SavedModelExportGenerator()
+    path = gen.export(model, jax.device_get(state), model_dir)
+    assert os.path.isdir(path)
+    assets = specs.read_assets(
+        os.path.join(path, "assets.extra", specs.ASSET_FILENAME))
+    flat = assets["feature_spec"].to_flat_dict()
+    wire = specs.flatten_spec_structure(
+        model.preprocessor.get_in_feature_specification(
+            Mode.PREDICT)).to_flat_dict()
+    assert set(flat) == set(wire)
+    assert assets["global_step"] == 4
+
+  def test_latest_export_dir_picks_newest(self, trained, tmp_path):
+    base = str(tmp_path / "exports")
+    for ts in ("100", "200", "50"):
+      os.makedirs(os.path.join(base, ts))
+    assert latest_export_dir(base).endswith("200")
+
+  def test_savedmodel_predictor_round_trip(self, trained):
+    model, state, model_dir = trained
+    predictor = SavedModelPredictor(os.path.join(model_dir, "export"))
+    assert predictor.restore(timeout_secs=0)
+    assert predictor.model_version > 0
+    assert predictor.global_step == 4
+    batch = specs.make_random_tensors(
+        predictor.feature_specification, batch_size=3, seed=1)
+    out = predictor.predict(batch.to_flat_dict())
+    value = next(iter(out.values()))
+    assert value.shape[0] == 3
+
+  def test_predictor_validates_inputs(self, trained):
+    model, state, model_dir = trained
+    predictor = SavedModelPredictor(os.path.join(model_dir, "export"))
+    predictor.restore(timeout_secs=0)
+    batch = specs.make_random_tensors(
+        predictor.feature_specification, batch_size=2, seed=1)
+    flat = batch.to_flat_dict()
+    key = next(iter(flat))
+    flat[key] = flat[key][..., :-1]  # corrupt trailing dim
+    with pytest.raises(specs.SpecValidationError):
+      predictor.predict(flat)
+
+  def test_unrestored_predictor_raises(self, tmp_path):
+    predictor = SavedModelPredictor(str(tmp_path / "nothing"))
+    assert not predictor.restore(timeout_secs=0)
+    with pytest.raises(ValueError, match="restore"):
+      predictor.predict({})
+
+
+class TestCheckpointPredictor:
+
+  def test_restore_and_predict(self, trained):
+    model, state, model_dir = trained
+    predictor = CheckpointPredictor(model, checkpoint_dir=model_dir)
+    assert predictor.restore(timeout_secs=0)
+    assert predictor.model_version == 4
+    batch = specs.make_random_tensors(
+        predictor.feature_specification, batch_size=2, seed=2)
+    out = predictor.predict(batch.to_flat_dict())
+    value = next(iter(out.values()))
+    assert value.shape[0] == 2
+
+  def test_init_randomly(self):
+    model = MockT2RModel()
+    predictor = CheckpointPredictor(model)
+    predictor.init_randomly()
+    batch = specs.make_random_tensors(
+        predictor.feature_specification, batch_size=2, seed=3)
+    out = predictor.predict(batch.to_flat_dict())
+    assert next(iter(out.values())).shape[0] == 2
+
+  def test_no_checkpoint_yet(self, tmp_path):
+    model = MockT2RModel()
+    predictor = CheckpointPredictor(
+        model, checkpoint_dir=str(tmp_path / "empty"))
+    assert not predictor.restore(timeout_secs=0)
+
+
+class TestAsyncExportHook:
+
+  def test_hook_exports_on_checkpoint(self, tmp_path):
+    model_dir = str(tmp_path / "hooked")
+    hook = AsyncExportHook(SavedModelExportGenerator(), block=True)
+    train_eval.train_eval_model(
+        model=MockT2RModel(),
+        model_dir=model_dir,
+        input_generator_train=RandomInputGenerator(batch_size=8),
+        max_train_steps=2,
+        save_checkpoints_steps=2,
+        hooks=[hook],
+    )
+    assert hook.export_paths
+    assert latest_export_dir(os.path.join(model_dir, "export"))
+
+  def test_hook_cadence(self, tmp_path):
+    hook = AsyncExportHook(SavedModelExportGenerator(),
+                           export_every_n_checkpoints=2, block=True)
+    train_eval.train_eval_model(
+        model=MockT2RModel(),
+        model_dir=str(tmp_path / "cadence"),
+        input_generator_train=RandomInputGenerator(batch_size=8),
+        max_train_steps=4,
+        save_checkpoints_steps=1,
+        hooks=[hook],
+    )
+    # 4 checkpoints (+ final dedupe) at every-2 cadence -> 2 exports.
+    assert len(hook.export_paths) == 2
