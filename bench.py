@@ -1,0 +1,67 @@
+"""Benchmark: flagship training throughput on the local accelerator.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no throughput numbers (BASELINE.md), so
+`vs_baseline` is measured against the driver's north-star target of
+10,000 QT-Opt-scale grad steps/sec on a v5e-64 pod — i.e. a per-chip
+share of 156.25 steps/sec. value / 156.25 >= 1.0 means this single
+chip is on pace for the pod-level target.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import numpy as np
+
+PER_CHIP_TARGET = 10_000 / 64.0  # north-star pod target, per chip
+
+
+def main():
+  from tensor2robot_tpu import specs
+  from tensor2robot_tpu.data.abstract_input_generator import Mode
+  from tensor2robot_tpu.research.pose_env import PoseEnvRegressionModel
+
+  batch_size = 128
+  model = PoseEnvRegressionModel()  # bf16 compute, 64x64 images
+  state = model.create_train_state(jax.random.PRNGKey(0), batch_size=2)
+
+  features = specs.make_random_tensors(
+      model.preprocessor.get_in_feature_specification(Mode.TRAIN),
+      batch_size=batch_size, seed=0)
+  labels = specs.make_random_tensors(
+      model.preprocessor.get_in_label_specification(Mode.TRAIN),
+      batch_size=batch_size, seed=1)
+  features = jax.device_put(
+      jax.tree_util.tree_map(np.asarray, features))
+  labels = jax.device_put(jax.tree_util.tree_map(np.asarray, labels))
+
+  step = jax.jit(model.train_step, donate_argnums=(0,))
+  rng = jax.random.PRNGKey(2)
+
+  # Warmup: compile + one real step.
+  state, metrics = step(state, features, labels, rng)
+  jax.block_until_ready(metrics["loss"])
+
+  n_steps = 200
+  start = time.perf_counter()
+  for i in range(n_steps):
+    state, metrics = step(state, features, labels,
+                          jax.random.fold_in(rng, i))
+  jax.block_until_ready(metrics["loss"])
+  elapsed = time.perf_counter() - start
+
+  steps_per_sec = n_steps / elapsed
+  print(json.dumps({
+      "metric": "pose_env_train_steps_per_sec_per_chip",
+      "value": round(steps_per_sec, 2),
+      "unit": f"steps/s (batch={batch_size}, 64x64 uint8 images, bf16)",
+      "vs_baseline": round(steps_per_sec / PER_CHIP_TARGET, 3),
+  }))
+
+
+if __name__ == "__main__":
+  main()

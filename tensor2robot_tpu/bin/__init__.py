@@ -1,0 +1,1 @@
+"""Entry-point binaries (reference: tensor2robot bin/)."""

@@ -20,6 +20,15 @@ import time
 from typing import Any, Optional
 
 
+def sanitize_signature_key(key: str) -> str:
+  """Flat spec key → TF signature tensor name (no '/' allowed).
+
+  This is a WIRE CONTRACT between exporters and SavedModel predictors;
+  both sides must use this one helper.
+  """
+  return key.replace("/", "_")
+
+
 def claim_timestamped_export_dir(export_dir_base: str) -> tuple:
   """Atomically claims `<base>/<unix_ts>`; returns (final_dir, tmp_dir).
 

@@ -33,6 +33,7 @@ from tensor2robot_tpu.data.abstract_input_generator import Mode
 from tensor2robot_tpu.export.abstract_export_generator import (
     AbstractExportGenerator,
     claim_timestamped_export_dir,
+    sanitize_signature_key,
 )
 
 
@@ -97,7 +98,7 @@ class SavedModelExportGenerator(AbstractExportGenerator):
     input_sigs = {
         key: tf.TensorSpec([batch_dim] + list(spec.shape),
                            _tf_dtype(tf, spec),
-                           name=key.replace("/", "_"))
+                           name=sanitize_signature_key(key))
         for key, spec in flat_specs.items()
     }
 
@@ -125,6 +126,17 @@ class SavedModelExportGenerator(AbstractExportGenerator):
                 lambda b: tf.io.decode_image(
                     b, channels=spec.shape[-1], expand_animations=False),
                 value, fn_output_signature=tf.uint8)
+          if spec.varlen:
+            # Parity with the training parser's _pad_or_truncate: a
+            # ragged feature is zero-padded / truncated to the declared
+            # length, never rejected.
+            flat_len = int(np.prod(spec.shape))
+            value = tf.reshape(value, [tf.shape(value)[0], -1])
+            cur = tf.shape(value)[1]
+            value = tf.cond(
+                cur < flat_len,
+                lambda: tf.pad(value, [[0, 0], [0, flat_len - cur]]),
+                lambda: value[:, :flat_len])
           value = tf.reshape(
               value, [-1] + list(spec.shape))
           flat[key] = tf.cast(value, _tf_dtype(tf, spec))

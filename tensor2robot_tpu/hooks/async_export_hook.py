@@ -62,8 +62,13 @@ class AsyncExportHook(Hook):
     if self._count % self._every_n != 0:
       return
     # Snapshot to host now: the training loop donates/overwrites the
-    # device state buffers on the very next step.
-    host_state = jax.device_get(state)
+    # device state buffers on the very next step. Only the pieces the
+    # export reads — pulling optimizer moments (~2x params for Adam)
+    # would stall the training thread for nothing.
+    if hasattr(state, "replace") and hasattr(state, "opt_state"):
+      host_state = jax.device_get(state.replace(opt_state=None))
+    else:
+      host_state = jax.device_get(state)
     if self._block:
       self._export(host_state, model_dir)
       return

@@ -1,0 +1,17 @@
+"""Mesh construction and sharding rules (TPU-native distribution layer)."""
+
+from tensor2robot_tpu.parallel.mesh import (
+    DATA_AXIS,
+    FSDP_AXIS,
+    MODEL_AXIS,
+    SEQ_AXIS,
+    batch_sharding,
+    create_mesh,
+    local_batch_size,
+    replicated,
+)
+from tensor2robot_tpu.parallel.sharding import (
+    fsdp_sharding,
+    state_sharding,
+    tensor_parallel_sharding,
+)

@@ -1,0 +1,96 @@
+"""Parameter/optimizer sharding rules over the named mesh.
+
+The reference's only distribution strategy was TPUEstimator data
+parallelism (SURVEY.md §3 parallelism inventory). Here sharding is a
+first-class design axis: given a mesh with `fsdp` (zero-style parameter
+sharding) and/or `model` (tensor-parallel) axes, these helpers derive
+NamedShardings for every leaf of a param/opt pytree, and GSPMD inserts
+the all-gathers/reduce-scatters over ICI.
+
+Heuristics (CNN/MLP-scale models; large transformers would add explicit
+per-layer rules):
+  * fsdp: shard the LARGEST divisible dim of each leaf; leaves smaller
+    than `min_size_to_shard` stay replicated (latency > memory win).
+  * model: dense kernels additionally split their output dim when
+    divisible (megatron-style column parallel) — opt-in.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tensor2robot_tpu.parallel.mesh import FSDP_AXIS, MODEL_AXIS
+
+
+def fsdp_sharding(
+    mesh: Mesh,
+    tree: Any,
+    min_size_to_shard: int = 2 ** 10,
+) -> Any:
+  """NamedSharding pytree: largest divisible dim of each leaf on fsdp.
+
+  Works on arrays or ShapeDtypeStructs. Leaves without a divisible dim
+  (or too small) replicate. Optimizer states mirror their param leaf by
+  construction (same shapes ⇒ same rule).
+  """
+  if FSDP_AXIS not in mesh.axis_names:
+    repl = NamedSharding(mesh, P())
+    return jax.tree_util.tree_map(lambda _: repl, tree)
+  size = mesh.shape[FSDP_AXIS]
+
+  def rule(leaf):
+    shape = getattr(leaf, "shape", ())
+    if not shape or int(np.prod(shape)) < min_size_to_shard:
+      return NamedSharding(mesh, P())
+    order = sorted(range(len(shape)), key=lambda i: -shape[i])
+    for dim in order:
+      if shape[dim] % size == 0:
+        spec = [None] * len(shape)
+        spec[dim] = FSDP_AXIS
+        return NamedSharding(mesh, P(*spec))
+    return NamedSharding(mesh, P())
+
+  return jax.tree_util.tree_map(rule, tree)
+
+
+def tensor_parallel_sharding(
+    mesh: Mesh,
+    tree: Any,
+    min_size_to_shard: int = 2 ** 12,
+) -> Any:
+  """Megatron-ish: 2D kernels split output dim on `model` (+fsdp on in-dim)."""
+  if MODEL_AXIS not in mesh.axis_names:
+    return fsdp_sharding(mesh, tree, min_size_to_shard)
+  tp = mesh.shape[MODEL_AXIS]
+  fsdp = mesh.shape.get(FSDP_AXIS, 1)
+  has_fsdp = FSDP_AXIS in mesh.axis_names
+
+  def rule(leaf):
+    shape = getattr(leaf, "shape", ())
+    if not shape or int(np.prod(shape)) < min_size_to_shard:
+      return NamedSharding(mesh, P())
+    if len(shape) >= 2 and shape[-1] % tp == 0:
+      spec = [None] * len(shape)
+      spec[-1] = MODEL_AXIS
+      if has_fsdp and shape[-2] % fsdp == 0:
+        spec[-2] = FSDP_AXIS
+      return NamedSharding(mesh, P(*spec))
+    if shape[-1] % tp == 0:
+      return NamedSharding(mesh, P(*([None] * (len(shape) - 1)),
+                                   MODEL_AXIS))
+    return NamedSharding(mesh, P())
+
+  return jax.tree_util.tree_map(rule, tree)
+
+
+def state_sharding(mesh: Mesh, state: Any,
+                   strategy: str = "fsdp",
+                   min_size_to_shard: int = 2 ** 10) -> Any:
+  """Shardings for a full TrainState (params + opt mirrors, scalars repl)."""
+  rule_fn = {"fsdp": fsdp_sharding,
+             "tp": tensor_parallel_sharding}[strategy]
+  return rule_fn(mesh, state, min_size_to_shard=min_size_to_shard)

@@ -41,12 +41,14 @@ class CheckpointPredictor(AbstractPredictor):
         jax.random.PRNGKey(0), batch_size=init_batch_size)
     self._restored_step = -1
     self._predict = jax.jit(model.predict_step)
+    # Immutable for the predictor's lifetime; predict() validates
+    # against it every control tick, so compute it once.
+    self._feature_spec = specs_lib.flatten_spec_structure(
+        model.preprocessor.get_in_feature_specification(Mode.PREDICT))
 
   @property
   def feature_specification(self) -> TensorSpecStruct:
-    return specs_lib.flatten_spec_structure(
-        self._model.preprocessor.get_in_feature_specification(
-            Mode.PREDICT))
+    return self._feature_spec
 
   @property
   def label_specification(self):

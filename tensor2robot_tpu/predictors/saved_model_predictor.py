@@ -22,6 +22,7 @@ from tensor2robot_tpu import config as gin
 from tensor2robot_tpu import specs as specs_lib
 from tensor2robot_tpu.export.abstract_export_generator import (
     latest_export_dir,
+    sanitize_signature_key,
 )
 from tensor2robot_tpu.predictors.abstract_predictor import (
     AbstractPredictor,
@@ -86,11 +87,16 @@ class SavedModelPredictor(AbstractPredictor):
   def _load(self, path: str, version: int) -> None:
     import tensorflow as tf  # lazy
 
-    loaded = tf.saved_model.load(path)
-    self._serving_fn = loaded.signatures[self._signature]
-    self._loaded = loaded  # keep alive: signatures hold weakrefs
+    # Read assets and resolve the signature FIRST: a broken export must
+    # leave the predictor fully on its previous version, never mixing
+    # new serving fn with old specs.
     assets = specs_lib.read_assets(
         os.path.join(path, "assets.extra", specs_lib.ASSET_FILENAME))
+    loaded = tf.saved_model.load(path)
+    serving_fn = loaded.signatures[self._signature]
+
+    self._serving_fn = serving_fn
+    self._loaded = loaded  # keep alive: signatures hold weakrefs
     self._feature_spec = assets["feature_spec"]
     self._label_spec = assets.get("label_spec")
     self._global_step = assets.get("global_step", -1)
@@ -100,16 +106,21 @@ class SavedModelPredictor(AbstractPredictor):
     import tensorflow as tf  # lazy
 
     self.assert_is_loaded()
+    if self._signature == "parse_tf_example":
+      # The proto signature takes ONE string tensor of serialized
+      # tf.Examples; spec validation happens inside the graph's parser.
+      value = features.get("examples", features) \
+          if isinstance(features, dict) else features
+      serialized = tf.convert_to_tensor(
+          np.asarray(value, dtype=object), dtype=tf.string)
+      outputs = self._serving_fn(examples=serialized)
+      return {k: v.numpy() for k, v in outputs.items()}
     packed = self._validate(features)
     flat = packed.to_flat_dict() if isinstance(packed, TensorSpecStruct) \
         else dict(packed)
     # Signature inputs are flat keys; TF rejects '/' in arg names, so
-    # exported signatures use the sanitized form.
-    feed = {_sanitize(k): tf.convert_to_tensor(np.asarray(v))
+    # exported signatures use the sanitized form (shared wire contract).
+    feed = {sanitize_signature_key(k): tf.convert_to_tensor(np.asarray(v))
             for k, v in flat.items()}
     outputs = self._serving_fn(**feed)
     return {k: v.numpy() for k, v in outputs.items()}
-
-
-def _sanitize(key: str) -> str:
-  return key.replace("/", "_")

@@ -1,0 +1,1 @@
+"""Research model families (reference: tensor2robot research/)."""

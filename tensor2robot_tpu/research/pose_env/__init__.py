@@ -1,0 +1,10 @@
+"""pose_env research family (reference: tensor2robot research/pose_env/)."""
+
+from tensor2robot_tpu.research.pose_env.pose_env import (
+    PoseEnv,
+    collect_random_episodes,
+    evaluate_pose_model,
+)
+from tensor2robot_tpu.research.pose_env.pose_env_models import (
+    PoseEnvRegressionModel,
+)

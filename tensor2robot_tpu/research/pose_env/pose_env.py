@@ -1,0 +1,147 @@
+"""Pose environment: the smallest end-to-end task in the framework.
+
+Reference parity: tensor2robot `research/pose_env/pose_env.py` — a toy
+PyBullet task (predict a target object's planar pose from a rendered
+camera image) with random-collect and eval scripts; the reference's
+minimal proof that specs → data → train → export → predict all work
+(SURVEY.md §3 "pose_env"; file:line unavailable — empty reference mount).
+
+This rebuild ships a dependency-free numpy renderer with the same task
+semantics (PyBullet isn't in the image; if `pybullet` is importable a
+physics-backed variant could subclass `PoseEnv`). An episode: a block
+is placed at a uniform random planar pose on a table; the observation
+is an RGB render; the label is the pose. `collect_random_episodes`
+writes spec-conforming TFRecords, `evaluate_pose_model` scores a
+predictor by mean pose error — the same collect/eval loop shape the
+reference's scripts had.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from tensor2robot_tpu import config as gin
+
+IMAGE_SIZE = 64
+# Reachable table region in world units; poses regress into this box.
+WORKSPACE_LOW = np.array([-0.4, -0.4], np.float32)
+WORKSPACE_HIGH = np.array([0.4, 0.4], np.float32)
+
+
+class PoseEnv:
+  """Numpy pose task: random block pose → rendered RGB observation."""
+
+  def __init__(self, image_size: int = IMAGE_SIZE, seed: int = 0,
+               block_half_extent: float = 0.06, noise: float = 0.02):
+    self._image_size = image_size
+    self._rng = np.random.default_rng(seed)
+    self._half = block_half_extent
+    self._noise = noise
+    self._pose: Optional[np.ndarray] = None
+
+  @property
+  def image_size(self) -> int:
+    return self._image_size
+
+  def reset(self) -> Dict[str, np.ndarray]:
+    """Samples a new block pose; returns the observation dict."""
+    self._pose = self._rng.uniform(
+        WORKSPACE_LOW, WORKSPACE_HIGH).astype(np.float32)
+    return self._observation()
+
+  def _world_to_pixel(self, xy: np.ndarray) -> Tuple[int, int]:
+    frac = (xy - WORKSPACE_LOW) / (WORKSPACE_HIGH - WORKSPACE_LOW)
+    px = np.clip((frac * self._image_size).astype(int), 0,
+                 self._image_size - 1)
+    return int(px[0]), int(px[1])
+
+  def _observation(self) -> Dict[str, np.ndarray]:
+    size = self._image_size
+    # Table: textured gray background with sensor noise.
+    image = np.full((size, size, 3), 96, np.uint8)
+    noise = self._rng.normal(0, 255 * self._noise, (size, size, 3))
+    image = np.clip(image + noise, 0, 255).astype(np.uint8)
+    # Block: red square centered at the pose.
+    cx, cy = self._world_to_pixel(self._pose)
+    extent = max(1, int(self._half / float(
+        WORKSPACE_HIGH[0] - WORKSPACE_LOW[0]) * size))
+    x0, x1 = max(0, cx - extent), min(size, cx + extent + 1)
+    y0, y1 = max(0, cy - extent), min(size, cy + extent + 1)
+    image[y0:y1, x0:x1] = np.array([200, 40, 40], np.uint8)
+    return {"image": image}
+
+  @property
+  def pose(self) -> np.ndarray:
+    if self._pose is None:
+      raise RuntimeError("Call reset() first.")
+    return self._pose
+
+
+@gin.configurable
+def collect_random_episodes(
+    output_path: str,
+    num_episodes: int = 100,
+    image_size: int = IMAGE_SIZE,
+    seed: int = 0,
+) -> str:
+  """Renders random poses into a TFRecord file of {image, target_pose}.
+
+  Reference parity: pose_env's random-collect script writing training
+  data for offline regression.
+  """
+  from tensor2robot_tpu.data.tfrecord_input_generator import (
+      write_tfrecord,
+  )
+  from tensor2robot_tpu.research.pose_env.pose_env_models import (
+      PoseEnvRegressionModel,
+  )
+  from tensor2robot_tpu.data.abstract_input_generator import Mode
+
+  env = PoseEnv(image_size=image_size, seed=seed)
+  model = PoseEnvRegressionModel(image_size=image_size)
+  examples = []
+  for _ in range(num_episodes):
+    obs = env.reset()
+    examples.append({"image": obs["image"],
+                     "target_pose": env.pose})
+  os.makedirs(os.path.dirname(output_path) or ".", exist_ok=True)
+  write_tfrecord(
+      output_path, examples,
+      model.get_feature_specification(Mode.TRAIN),
+      model.get_label_specification(Mode.TRAIN))
+  return output_path
+
+
+@gin.configurable
+def evaluate_pose_model(
+    predict_fn: Callable[[Dict[str, np.ndarray]], Dict[str, np.ndarray]],
+    num_episodes: int = 50,
+    image_size: int = IMAGE_SIZE,
+    seed: int = 1,
+    success_threshold: float = 0.05,
+) -> Dict[str, float]:
+  """Rolls the env and scores predicted poses against ground truth.
+
+  `predict_fn` maps a batched feature dict to an output dict whose first
+  value is the predicted pose (the predictor API). Returns mean L2 pose
+  error and success rate at `success_threshold` world units.
+  """
+  env = PoseEnv(image_size=image_size, seed=seed)
+  errors: List[float] = []
+  for _ in range(num_episodes):
+    obs = env.reset()
+    batch = {"image": obs["image"][None]}
+    out = predict_fn(batch)
+    value = out.get("inference_output",
+                    next(iter(out.values())))
+    predicted = np.asarray(value)[0].reshape(-1)[:2]
+    errors.append(float(np.linalg.norm(predicted - env.pose)))
+  errors_arr = np.asarray(errors)
+  return {
+      "mean_pose_error": float(errors_arr.mean()),
+      "success_rate": float((errors_arr < success_threshold).mean()),
+      "num_episodes": float(num_episodes),
+  }

@@ -1,0 +1,105 @@
+"""Pose regression models: image → planar pose.
+
+Reference parity: tensor2robot `research/pose_env/pose_env_models.py` —
+`PoseEnvRegressionModel` (conv encoder + regression head over rendered
+images; SURVEY.md §3 "pose_env"; file:line unavailable — empty
+reference mount).
+
+TPU-first: images stay uint8 across the host→device boundary (4× less
+infeed traffic) and are normalized on device, where the cast fuses into
+the first conv. The encoder is a small ConvTower + spatial softmax —
+keypoint pooling is exactly right for "where is the block".
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu import config as gin
+from tensor2robot_tpu.data.abstract_input_generator import Mode
+from tensor2robot_tpu.layers import ImageEncoder, MLP
+from tensor2robot_tpu.models.abstract_model import AbstractT2RModel
+from tensor2robot_tpu.models.regression_model import INFERENCE_OUTPUT
+from tensor2robot_tpu.specs import ExtendedTensorSpec, TensorSpecStruct
+
+
+class _PoseNetwork(nn.Module):
+  """uint8 image -> normalized floats -> encoder -> pose head."""
+
+  filters: Sequence[int]
+  embedding_size: int
+  hidden_sizes: Sequence[int]
+  output_size: int
+  dtype: jnp.dtype = jnp.bfloat16
+
+  @nn.compact
+  def __call__(self, features, train: bool = False):
+    image = features["image"]
+    image = image.astype(self.dtype) / jnp.asarray(255.0, self.dtype)
+    emb = ImageEncoder(
+        filters=tuple(self.filters),
+        embedding_size=self.embedding_size,
+        pooling="spatial_softmax",
+        dtype=self.dtype,
+        name="encoder",
+    )(image, train=train)
+    pose = MLP(hidden_sizes=tuple(self.hidden_sizes),
+               output_size=self.output_size, dtype=self.dtype,
+               name="head")(emb, train=train)
+    return {INFERENCE_OUTPUT: pose}
+
+
+@gin.configurable
+class PoseEnvRegressionModel(AbstractT2RModel):
+  """MSE pose regression from rendered images."""
+
+  def __init__(self,
+               image_size: int = 64,
+               pose_dim: int = 2,
+               filters: Sequence[int] = (32, 64, 128),
+               embedding_size: int = 128,
+               hidden_sizes: Sequence[int] = (64,),
+               device_dtype=jnp.bfloat16,
+               **kwargs):
+    super().__init__(device_dtype=device_dtype, **kwargs)
+    self._image_size = image_size
+    self._pose_dim = pose_dim
+    self._filters = tuple(filters)
+    self._embedding_size = embedding_size
+    self._hidden_sizes = tuple(hidden_sizes)
+
+  def get_feature_specification(self, mode: Mode) -> TensorSpecStruct:
+    st = TensorSpecStruct()
+    st.image = ExtendedTensorSpec(
+        shape=(self._image_size, self._image_size, 3), dtype=np.uint8,
+        name="image", data_format="jpeg")
+    return st
+
+  def get_label_specification(self, mode: Mode) -> TensorSpecStruct:
+    st = TensorSpecStruct()
+    st.target_pose = ExtendedTensorSpec(
+        shape=(self._pose_dim,), dtype=np.float32, name="target_pose")
+    return st
+
+  def create_network(self) -> nn.Module:
+    return _PoseNetwork(
+        filters=self._filters,
+        embedding_size=self._embedding_size,
+        hidden_sizes=self._hidden_sizes,
+        output_size=self._pose_dim,
+        dtype=self.device_dtype,
+    )
+
+  def model_train_fn(self, features, labels, outputs, mode
+                     ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    prediction = outputs[INFERENCE_OUTPUT].astype(jnp.float32)
+    target = labels["target_pose"].astype(jnp.float32)
+    loss = jnp.mean(jnp.square(prediction - target))
+    pose_error = jnp.mean(
+        jnp.linalg.norm(prediction - target, axis=-1))
+    return loss, {"mse": loss, "pose_error": pose_error}
