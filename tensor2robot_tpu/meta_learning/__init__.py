@@ -1,0 +1,11 @@
+"""Meta-learning (reference: tensor2robot meta_learning/)."""
+
+from tensor2robot_tpu.meta_learning.maml_model import (
+    CONDITION,
+    INFERENCE,
+    MAMLModel,
+)
+from tensor2robot_tpu.meta_learning.meta_data import (
+    MetaExampleInputGenerator,
+    make_meta_batch,
+)

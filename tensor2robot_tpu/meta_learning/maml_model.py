@@ -1,0 +1,296 @@
+"""MAML: model-agnostic meta-learning over any base T2R model.
+
+Reference parity: tensor2robot `meta_learning/maml_model.py` +
+`meta_tfdata.py` — condition/inference episode split, K inner gradient
+steps on condition data, outer loss on inference data, second-order
+gradients unless `first_order` (SURVEY.md §3 "MAML wrapper", §4.5;
+file:line unavailable — empty reference mount).
+
+TPU-native redesign: the reference built the inner loop by manually
+constructing TF graph ops over variable copies. In JAX the inner loop
+is literally `jax.grad` inside the outer loss, `lax.scan`ned over K
+steps and `vmap`ped over the task batch — one XLA program, second-order
+gradients for free, no variable bookkeeping. Meta-batch layout:
+
+  features.condition.<base feature keys>  [B_tasks, N_cond, ...]
+  features.inference.<base feature keys>  [B_tasks, N_inf, ...]
+  labels.condition.<base label keys>      [B_tasks, N_cond, ...]
+  labels.inference.<base label keys>      [B_tasks, N_inf, ...]
+
+which is the reference's meta-example structure expressed as a spec
+tree — so random meta-batches, parsers, and validation all come
+mechanically from the spec system, like everything else.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tensor2robot_tpu import config as gin
+from tensor2robot_tpu.data.abstract_input_generator import Mode
+from tensor2robot_tpu.models.abstract_model import AbstractT2RModel
+from tensor2robot_tpu.specs import (
+    ExtendedTensorSpec,
+    TensorSpecStruct,
+)
+
+CONDITION = "condition"
+INFERENCE = "inference"
+
+
+CONDITION_LABELS = "condition_labels"
+
+
+def _nest_spec(base_spec: Optional[TensorSpecStruct],
+               splits: Tuple[Tuple[str, int], ...],
+               optional: bool = False) -> Optional[TensorSpecStruct]:
+  """Wraps a base spec under per-split prefixes with per-task sample dims.
+
+  Wire names are prefixed too: condition/x and inference/x must be
+  DISTINCT tf.Example keys (same-named specs would silently collide in
+  every spec-name-keyed consumer, e.g. the TFExample feature map).
+  """
+  if base_spec is None:
+    return None
+  flat = base_spec.to_flat_dict() if isinstance(base_spec,
+                                                TensorSpecStruct) \
+      else dict(base_spec)
+  out = {}
+  for split, n in splits:
+    for key, spec in flat.items():
+      nested = spec.replace(
+          shape=(n,) + tuple(spec.shape),
+          name=f"{split}_{spec.name or key}")
+      if optional:
+        nested = nested.replace(is_optional=True)
+      out[f"{split}/{key}"] = nested
+  return TensorSpecStruct.from_flat_dict(out)
+
+
+def _split(struct: TensorSpecStruct, split: str) -> TensorSpecStruct:
+  """Extracts a split substructure (delegates to the container's paths)."""
+  return struct[split]
+
+
+@gin.configurable
+class MAMLModel(AbstractT2RModel):
+  """Meta-trains `base_model` with inner-loop adaptation.
+
+  Works with any base model whose network carries no mutable
+  batch-norm state (the reference's MAML models used BN-free nets for
+  the same reason: per-task adapted stats are ill-defined).
+  """
+
+  def __init__(self,
+               base_model: AbstractT2RModel,
+               num_inner_steps: int = 1,
+               inner_lr: float = 0.01,
+               first_order: bool = False,
+               learn_inner_lr: bool = False,
+               num_condition_samples_per_task: int = 4,
+               num_inference_samples_per_task: int = 4,
+               **kwargs):
+    kwargs.setdefault("device_dtype", base_model.device_dtype)
+    super().__init__(**kwargs)
+    self._base = base_model
+    self._num_inner_steps = num_inner_steps
+    self._inner_lr = inner_lr
+    self._first_order = first_order
+    self._learn_inner_lr = learn_inner_lr
+    self._num_condition = num_condition_samples_per_task
+    self._num_inference = num_inference_samples_per_task
+
+  @property
+  def base_model(self) -> AbstractT2RModel:
+    return self._base
+
+  # ---- specs: base specs nested under condition/inference ----
+
+  def get_feature_specification(self, mode: Mode) -> TensorSpecStruct:
+    spec = _nest_spec(
+        self._base.get_feature_specification(mode),
+        ((CONDITION, self._num_condition),
+         (INFERENCE, self._num_inference)))
+    if mode == Mode.PREDICT:
+      # Serving carries demonstration labels INSIDE the feature struct
+      # (optional: absent ⇒ zero-shot) so exported models and
+      # predictors have a real input for adaptation data.
+      base_labels = self._base.get_label_specification(mode)
+      demo = _nest_spec(base_labels,
+                        ((CONDITION_LABELS, self._num_condition),),
+                        optional=True)
+      if demo is not None:
+        flat = spec.to_flat_dict()
+        flat.update(demo.to_flat_dict())
+        spec = TensorSpecStruct.from_flat_dict(flat)
+    return spec
+
+  def get_label_specification(self, mode: Mode):
+    return _nest_spec(
+        self._base.get_label_specification(mode),
+        ((CONDITION, self._num_condition),
+         (INFERENCE, self._num_inference)))
+
+  # ---- network: the base network, with an optional inner-lr param ----
+
+  class _MetaNetwork(nn.Module):
+    base_net: nn.Module
+    learn_inner_lr: bool
+    init_inner_lr: float
+
+    @nn.compact
+    def __call__(self, features, train: bool = False):
+      if self.learn_inner_lr:
+        # Meta-SGD-style scalar learnable inner rate (participates in
+        # outer optimization; read via params during adaptation).
+        self.param("inner_lr_log",
+                   nn.initializers.constant(jnp.log(self.init_inner_lr)),
+                   ())
+      # Init path: run base net on the condition split so params exist.
+      cond = _split(features, CONDITION)
+      squeezed = jax.tree_util.tree_map(
+          lambda x: x.reshape((-1,) + x.shape[2:]), cond)
+      return self.base_net(squeezed, train=train)
+
+  def create_network(self) -> nn.Module:
+    return self._MetaNetwork(
+        base_net=self._base.network,
+        learn_inner_lr=self._learn_inner_lr,
+        init_inner_lr=self._inner_lr,
+    )
+
+  # ---- the meta loss ----
+
+  def model_train_fn(self, features, labels, outputs, mode):
+    """Unused: MAML overrides loss_fn wholesale (kept for the ABC)."""
+    raise NotImplementedError(
+        "MAMLModel computes its loss in loss_fn; model_train_fn is the "
+        "base model's.")
+
+  def _task_loss(self, base_params, features, labels, mode, rng,
+                 train: bool):
+    """Loss of the base model on ONE task's [N, ...] sample set."""
+    rngs = {"dropout": rng} if (train and rng is not None) else None
+    outputs = self._base.network.apply(
+        {"params": base_params}, features, train=train, rngs=rngs)
+    loss, scalars = self._base.model_train_fn(
+        features, labels, outputs, mode)
+    return loss, scalars
+
+  def _adapt(self, base_params, inner_lr, cond_f, cond_l, mode, rng,
+             train: bool = True):
+    """K inner SGD steps on the condition set; scanned, not unrolled."""
+
+    def one_step(params, step_rng):
+      grads = jax.grad(
+          lambda p: self._task_loss(p, cond_f, cond_l, mode,
+                                    step_rng if train else None,
+                                    train=train)[0])(params)
+      if self._first_order:
+        grads = jax.lax.stop_gradient(grads)
+      params = jax.tree_util.tree_map(
+          lambda p, g: p - inner_lr * g.astype(p.dtype), params, grads)
+      return params, ()
+
+    step_rngs = (jax.random.split(rng, self._num_inner_steps)
+                 if rng is not None else
+                 jnp.zeros((self._num_inner_steps, 2), jnp.uint32))
+    adapted, _ = jax.lax.scan(one_step, base_params, step_rngs)
+    return adapted
+
+  def loss_fn(self, params, batch_stats, features, labels, rng,
+              mode: Mode):
+    if batch_stats:
+      raise ValueError(
+          "MAMLModel requires a batch-stats-free base network "
+          "(use GroupNorm/LayerNorm instead of BatchNorm).")
+    train = mode == Mode.TRAIN
+    rng_pre, rng_net = (jax.random.split(rng) if rng is not None
+                        else (None, None))
+    features, labels = self.preprocessor.preprocess(
+        features, labels, mode, rng_pre)
+
+    # _MetaNetwork nests the base net's params under 'base_net'.
+    base_params = params["base_net"]
+    inner_lr = self._inner_lr
+    if self._learn_inner_lr:
+      inner_lr = jnp.exp(params["inner_lr_log"])
+
+    cond_f, inf_f = _split(features, CONDITION), _split(features,
+                                                        INFERENCE)
+    cond_l = _split(labels, CONDITION) if labels is not None else None
+    inf_l = _split(labels, INFERENCE) if labels is not None else None
+
+    num_tasks = jax.tree_util.tree_leaves(cond_f)[0].shape[0]
+    task_rngs = (jax.random.split(rng_net, num_tasks)
+                 if rng_net is not None else
+                 jnp.zeros((num_tasks, 2), jnp.uint32))
+
+    def per_task(cond_f, cond_l, inf_f, inf_l, task_rng):
+      rng_adapt, rng_outer = jax.random.split(task_rng)
+      adapted = self._adapt(base_params, inner_lr, cond_f, cond_l, mode,
+                            rng_adapt, train=train)
+      outer_loss, outer_scalars = self._task_loss(
+          adapted, inf_f, inf_l, mode, rng_outer if train else None,
+          train=train)
+      pre_loss, _ = self._task_loss(
+          base_params, inf_f, inf_l, mode, None, train=False)
+      return outer_loss, pre_loss, outer_scalars
+
+    outer_losses, pre_losses, scalars = jax.vmap(per_task)(
+        cond_f, cond_l, inf_f, inf_l, task_rngs)
+    loss = jnp.mean(outer_losses)
+    metrics = {k: jnp.mean(v) for k, v in scalars.items()}
+    metrics["pre_adaptation_loss"] = jnp.mean(pre_losses)
+    metrics["post_adaptation_loss"] = loss
+    return loss, (metrics, batch_stats)
+
+  def eval_step(self, state, features, labels) -> Dict[str, jax.Array]:
+    """Eval = the meta loss without gradients (adaptation still runs)."""
+    loss, (metrics, _) = self.loss_fn(
+        state.params, state.batch_stats, features, labels, None,
+        Mode.EVAL)
+    return {"loss": loss, **metrics}
+
+  # ---- serving: adapt on condition, answer on inference ----
+
+  def predict_step(self, state, features) -> Any:
+    features, _ = self.preprocessor.preprocess(
+        features, None, Mode.PREDICT, None)
+    base_params = state.params["base_net"]
+    inner_lr = self._inner_lr
+    if self._learn_inner_lr:
+      inner_lr = jnp.exp(state.params["inner_lr_log"])
+    cond_f = _split(features, CONDITION)
+    inf_f = _split(features, INFERENCE)
+    # At predict time the condition labels ride along INSIDE the feature
+    # struct when the task supplies demonstrations; reference meta
+    # policies conditioned the same way. Without labels in features,
+    # adaptation is skipped (zero-shot).
+    cond_l = None
+    flat = features.to_flat_dict()
+    prefix = CONDITION_LABELS + "/"
+    label_keys = [k for k in flat if k.startswith(prefix)]
+    if label_keys:
+      cond_l = TensorSpecStruct.from_flat_dict(
+          {k[len(prefix):]: flat[k] for k in label_keys})
+
+    def per_task(cond_f, cond_l, inf_f):
+      if cond_l is not None:
+        adapted = self._adapt(base_params, inner_lr, cond_f, cond_l,
+                              Mode.PREDICT,
+                              jax.random.PRNGKey(0), train=False)
+      else:
+        adapted = base_params
+      return self._base.network.apply({"params": adapted}, inf_f,
+                                      train=False)
+
+    if cond_l is not None:
+      return jax.vmap(lambda cf, cl, inf: per_task(cf, cl, inf))(
+          cond_f, cond_l, inf_f)
+    return jax.vmap(lambda cf, inf: per_task(cf, None, inf))(cond_f,
+                                                             inf_f)

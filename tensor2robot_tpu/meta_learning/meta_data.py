@@ -1,0 +1,107 @@
+"""Meta-batch construction utilities.
+
+Reference parity: tensor2robot `meta_learning/meta_tfdata.py` — turning
+flat example streams into meta-example batches of (condition, inference)
+sample sets per task (SURVEY.md §3 "MAML wrapper"; file:line unavailable
+— empty reference mount).
+
+Host-side numpy transforms: the meta-batch layout is just a reshape of
+a flat batch, so any existing input generator becomes a meta generator
+by wrapping it.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+from tensor2robot_tpu import config as gin
+from tensor2robot_tpu.data.abstract_input_generator import (
+    AbstractInputGenerator,
+    Mode,
+)
+from tensor2robot_tpu.meta_learning.maml_model import (
+    CONDITION,
+    INFERENCE,
+)
+from tensor2robot_tpu.specs import TensorSpecStruct
+
+
+def make_meta_batch(features: TensorSpecStruct,
+                    labels: Optional[TensorSpecStruct],
+                    num_condition: int,
+                    num_inference: int
+                    ) -> Tuple[TensorSpecStruct,
+                               Optional[TensorSpecStruct]]:
+  """Reshapes a flat batch [B, ...] into a meta batch.
+
+  B must be divisible by (num_condition + num_inference); the result has
+  B / (num_condition + num_inference) tasks. Consecutive samples are
+  assigned to the same task (callers wanting task coherence should feed
+  episode-grouped batches, as the reference's episode_to_transitions
+  pipelines did).
+  """
+  per_task = num_condition + num_inference
+
+  def nest(struct):
+    if struct is None:
+      return None
+    flat = struct.to_flat_dict()
+    out = {}
+    for key, value in flat.items():
+      batch = value.shape[0]
+      if batch % per_task:
+        raise ValueError(
+            f"Batch {batch} not divisible by condition+inference = "
+            f"{per_task} (key {key!r}).")
+      tasks = value.reshape((batch // per_task, per_task) +
+                            value.shape[1:])
+      out[f"{CONDITION}/{key}"] = tasks[:, :num_condition]
+      out[f"{INFERENCE}/{key}"] = tasks[:, num_condition:]
+    return TensorSpecStruct.from_flat_dict(out)
+
+  return nest(features), nest(labels)
+
+
+@gin.configurable
+class MetaExampleInputGenerator(AbstractInputGenerator):
+  """Wraps a flat generator into meta-example batches.
+
+  `batch_size` counts TASKS; the inner generator is driven at
+  tasks × (num_condition + num_inference) samples per step.
+  """
+
+  def __init__(self,
+               base_generator: AbstractInputGenerator,
+               num_condition_samples_per_task: int = 4,
+               num_inference_samples_per_task: int = 4,
+               batch_size: int = 8):
+    super().__init__(batch_size=batch_size)
+    self._base = base_generator
+    self._num_condition = num_condition_samples_per_task
+    self._num_inference = num_inference_samples_per_task
+
+  def set_specification_from_model(self, model, mode: Mode) -> None:
+    # The model is a MAMLModel: its specs are the nested meta specs;
+    # the BASE generator needs the base model's flat specs.
+    base_model = getattr(model, "base_model", None)
+    if base_model is not None:
+      self._base.set_specification_from_model(base_model, mode)
+      self.set_specification(
+          model.preprocessor.get_in_feature_specification(mode),
+          model.preprocessor.get_in_label_specification(mode))
+    else:
+      self._base.set_specification_from_model(model, mode)
+      self.set_specification(self._base.feature_spec,
+                             self._base.label_spec)
+
+  def _create_dataset(self, mode: Mode, batch_size: int
+                      ) -> Iterator[Tuple[TensorSpecStruct,
+                                          Optional[TensorSpecStruct]]]:
+    per_task = self._num_condition + self._num_inference
+    flat_batch = batch_size * per_task
+    for features, labels in self._base.create_dataset(
+        mode, batch_size=flat_batch):
+      yield make_meta_batch(features, labels, self._num_condition,
+                            self._num_inference)

@@ -1,0 +1,51 @@
+"""MAML variants of the pose models.
+
+Reference parity: tensor2robot `research/pose_env/pose_env_maml_models.py`
+— the pose regression task wrapped for meta-learning (SURVEY.md §3
+"pose_env"; file:line unavailable — empty reference mount).
+
+The base net here is BatchNorm-free (MAML requirement — per-task
+adapted BN stats are ill-defined), so the encoder disables norm layers.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from tensor2robot_tpu import config as gin
+from tensor2robot_tpu.meta_learning import MAMLModel
+from tensor2robot_tpu.research.pose_env.pose_env_models import (
+    PoseEnvRegressionModel,
+)
+
+
+@gin.configurable
+class PoseEnvRegressionModelMAML(MAMLModel):
+  """MAML over a BN-free pose regression base."""
+
+  def __init__(self,
+               image_size: int = 64,
+               pose_dim: int = 2,
+               filters: Sequence[int] = (16, 32),
+               embedding_size: int = 64,
+               hidden_sizes: Sequence[int] = (64,),
+               num_inner_steps: int = 1,
+               inner_lr: float = 0.05,
+               first_order: bool = False,
+               num_condition_samples_per_task: int = 4,
+               num_inference_samples_per_task: int = 4,
+               **kwargs):
+    base = PoseEnvRegressionModel(
+        image_size=image_size, pose_dim=pose_dim, filters=filters,
+        embedding_size=embedding_size, hidden_sizes=hidden_sizes,
+        use_batch_norm=False)
+    super().__init__(
+        base_model=base,
+        num_inner_steps=num_inner_steps,
+        inner_lr=inner_lr,
+        first_order=first_order,
+        num_condition_samples_per_task=num_condition_samples_per_task,
+        num_inference_samples_per_task=num_inference_samples_per_task,
+        **kwargs)
+
+

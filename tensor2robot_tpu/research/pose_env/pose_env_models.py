@@ -35,6 +35,7 @@ class _PoseNetwork(nn.Module):
   embedding_size: int
   hidden_sizes: Sequence[int]
   output_size: int
+  use_batch_norm: bool = True
   dtype: jnp.dtype = jnp.bfloat16
 
   @nn.compact
@@ -45,6 +46,7 @@ class _PoseNetwork(nn.Module):
         filters=tuple(self.filters),
         embedding_size=self.embedding_size,
         pooling="spatial_softmax",
+        use_batch_norm=self.use_batch_norm,
         dtype=self.dtype,
         name="encoder",
     )(image, train=train)
@@ -64,6 +66,7 @@ class PoseEnvRegressionModel(AbstractT2RModel):
                filters: Sequence[int] = (32, 64, 128),
                embedding_size: int = 128,
                hidden_sizes: Sequence[int] = (64,),
+               use_batch_norm: bool = True,
                device_dtype=jnp.bfloat16,
                **kwargs):
     super().__init__(device_dtype=device_dtype, **kwargs)
@@ -72,6 +75,7 @@ class PoseEnvRegressionModel(AbstractT2RModel):
     self._filters = tuple(filters)
     self._embedding_size = embedding_size
     self._hidden_sizes = tuple(hidden_sizes)
+    self._use_batch_norm = use_batch_norm
 
   def get_feature_specification(self, mode: Mode) -> TensorSpecStruct:
     st = TensorSpecStruct()
@@ -92,6 +96,7 @@ class PoseEnvRegressionModel(AbstractT2RModel):
         embedding_size=self._embedding_size,
         hidden_sizes=self._hidden_sizes,
         output_size=self._pose_dim,
+        use_batch_norm=self._use_batch_norm,
         dtype=self.device_dtype,
     )
 
