@@ -33,10 +33,7 @@ import jax.numpy as jnp
 from tensor2robot_tpu import config as gin
 from tensor2robot_tpu.data.abstract_input_generator import Mode
 from tensor2robot_tpu.models.abstract_model import AbstractT2RModel
-from tensor2robot_tpu.specs import (
-    ExtendedTensorSpec,
-    TensorSpecStruct,
-)
+from tensor2robot_tpu.specs import TensorSpecStruct
 
 CONDITION = "condition"
 INFERENCE = "inference"
@@ -65,6 +62,11 @@ def _nest_spec(base_spec: Optional[TensorSpecStruct],
       nested = spec.replace(
           shape=(n,) + tuple(spec.shape),
           name=f"{split}_{spec.name or key}")
+      if nested.data_format is not None:
+        # A jpeg/png wire encoding holds ONE image; the nested
+        # (N, H, W, C) sample set must travel as raw numeric data or
+        # the tf.Example feature map cannot represent it.
+        nested = nested.replace(data_format=None)
       if optional:
         nested = nested.replace(is_optional=True)
       out[f"{split}/{key}"] = nested
@@ -74,6 +76,100 @@ def _nest_spec(base_spec: Optional[TensorSpecStruct],
 def _split(struct: TensorSpecStruct, split: str) -> TensorSpecStruct:
   """Extracts a split substructure (delegates to the container's paths)."""
   return struct[split]
+
+
+class MAMLPreprocessor:
+  """Runs the BASE model's preprocessor on each meta split.
+
+  Reference parity: the meta_learning preprocessor wrapper
+  (SURVEY.md §3 "MAML wrapper" — `meta_learning/preprocessors.py`): the
+  base model's wire↔model spec contract (image crop/distort, dtype
+  casts) must survive meta-wrapping. Per split, the task dim folds into
+  the batch dim, the base preprocess runs, and the result unfolds back.
+  """
+
+  def __init__(self, base_preprocessor, num_condition: int,
+               num_inference: int, base_label_spec_fn):
+    self._base = base_preprocessor
+    self._num_condition = num_condition
+    self._num_inference = num_inference
+    self._base_label_spec_fn = base_label_spec_fn
+
+  def _splits(self):
+    return ((CONDITION, self._num_condition),
+            (INFERENCE, self._num_inference))
+
+  def get_in_feature_specification(self, mode: Mode) -> TensorSpecStruct:
+    spec = _nest_spec(self._base.get_in_feature_specification(mode),
+                      self._splits())
+    if mode == Mode.PREDICT:
+      demo = _nest_spec(self._base.get_in_label_specification(mode),
+                        ((CONDITION_LABELS, self._num_condition),),
+                        optional=True)
+      if demo is not None:
+        flat = spec.to_flat_dict()
+        flat.update(demo.to_flat_dict())
+        spec = TensorSpecStruct.from_flat_dict(flat)
+    return spec
+
+  def get_in_label_specification(self, mode: Mode):
+    return _nest_spec(self._base.get_in_label_specification(mode),
+                      self._splits())
+
+  def get_out_feature_specification(self, mode: Mode) -> TensorSpecStruct:
+    spec = _nest_spec(self._base.get_out_feature_specification(mode),
+                      self._splits())
+    if mode == Mode.PREDICT:
+      demo = _nest_spec(self._base.get_out_label_specification(mode),
+                        ((CONDITION_LABELS, self._num_condition),),
+                        optional=True)
+      if demo is not None:
+        flat = spec.to_flat_dict()
+        flat.update(demo.to_flat_dict())
+        spec = TensorSpecStruct.from_flat_dict(flat)
+    return spec
+
+  def get_out_label_specification(self, mode: Mode):
+    return _nest_spec(self._base.get_out_label_specification(mode),
+                      self._splits())
+
+  def preprocess(self, features, labels, mode: Mode, rng=None):
+    import jax as _jax
+
+    out_f, out_l = {}, {}
+    flat_features = features.to_flat_dict()
+    has_labels = labels is not None
+    rngs = (_jax.random.split(rng, 2) if rng is not None
+            else (None, None))
+    for i, (split, n) in enumerate(self._splits()):
+      f = _split(features, split)
+      l = _split(labels, split) if has_labels else None
+
+      num_tasks = _jax.tree_util.tree_leaves(f)[0].shape[0]
+
+      def fold(x):
+        return x.reshape((num_tasks * n,) + x.shape[2:])
+
+      def unfold(x):
+        return x.reshape((num_tasks, n) + x.shape[1:])
+
+      f2, l2 = self._base.preprocess(
+          _jax.tree_util.tree_map(fold, f),
+          _jax.tree_util.tree_map(fold, l) if l is not None else None,
+          mode, rngs[i])
+      for key, value in f2.to_flat_dict().items():
+        out_f[f"{split}/{key}"] = unfold(value)
+      if l2 is not None:
+        for key, value in l2.to_flat_dict().items():
+          out_l[f"{split}/{key}"] = unfold(value)
+    # Demonstration labels (predict-time adaptation data) pass through.
+    for key, value in flat_features.items():
+      if key.startswith(CONDITION_LABELS + "/"):
+        out_f[key] = value
+    features_out = TensorSpecStruct.from_flat_dict(out_f)
+    labels_out = TensorSpecStruct.from_flat_dict(out_l) if out_l else \
+        (labels if has_labels else None)
+    return features_out, labels_out
 
 
 @gin.configurable
@@ -93,6 +189,7 @@ class MAMLModel(AbstractT2RModel):
                learn_inner_lr: bool = False,
                num_condition_samples_per_task: int = 4,
                num_inference_samples_per_task: int = 4,
+               report_pre_adaptation_loss: bool = False,
                **kwargs):
     kwargs.setdefault("device_dtype", base_model.device_dtype)
     super().__init__(**kwargs)
@@ -103,10 +200,20 @@ class MAMLModel(AbstractT2RModel):
     self._learn_inner_lr = learn_inner_lr
     self._num_condition = num_condition_samples_per_task
     self._num_inference = num_inference_samples_per_task
+    self._report_pre_adaptation_loss = report_pre_adaptation_loss
 
   @property
   def base_model(self) -> AbstractT2RModel:
     return self._base
+
+  @property
+  def preprocessor(self):
+    """The base model's preprocessor, lifted over the meta splits."""
+    if self._preprocessor is None:
+      self._preprocessor = MAMLPreprocessor(
+          self._base.preprocessor, self._num_condition,
+          self._num_inference, self._base.get_label_specification)
+    return self._preprocessor
 
   # ---- specs: base specs nested under condition/inference ----
 
@@ -230,6 +337,10 @@ class MAMLModel(AbstractT2RModel):
                  if rng_net is not None else
                  jnp.zeros((num_tasks, 2), jnp.uint32))
 
+    # The pre-adaptation diagnostic costs a third forward pass per task;
+    # only pay for it in eval (or when explicitly requested).
+    report_pre = self._report_pre_adaptation_loss or not train
+
     def per_task(cond_f, cond_l, inf_f, inf_l, task_rng):
       rng_adapt, rng_outer = jax.random.split(task_rng)
       adapted = self._adapt(base_params, inner_lr, cond_f, cond_l, mode,
@@ -237,15 +348,19 @@ class MAMLModel(AbstractT2RModel):
       outer_loss, outer_scalars = self._task_loss(
           adapted, inf_f, inf_l, mode, rng_outer if train else None,
           train=train)
-      pre_loss, _ = self._task_loss(
-          base_params, inf_f, inf_l, mode, None, train=False)
+      if report_pre:
+        pre_loss, _ = self._task_loss(
+            base_params, inf_f, inf_l, mode, None, train=False)
+      else:
+        pre_loss = jnp.zeros(())
       return outer_loss, pre_loss, outer_scalars
 
     outer_losses, pre_losses, scalars = jax.vmap(per_task)(
         cond_f, cond_l, inf_f, inf_l, task_rngs)
     loss = jnp.mean(outer_losses)
     metrics = {k: jnp.mean(v) for k, v in scalars.items()}
-    metrics["pre_adaptation_loss"] = jnp.mean(pre_losses)
+    if report_pre:
+      metrics["pre_adaptation_loss"] = jnp.mean(pre_losses)
     metrics["post_adaptation_loss"] = loss
     return loss, (metrics, batch_stats)
 

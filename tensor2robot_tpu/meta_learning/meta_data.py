@@ -14,8 +14,6 @@ from __future__ import annotations
 
 from typing import Iterator, Optional, Tuple
 
-import numpy as np
-
 from tensor2robot_tpu import config as gin
 from tensor2robot_tpu.data.abstract_input_generator import (
     AbstractInputGenerator,
@@ -92,9 +90,10 @@ class MetaExampleInputGenerator(AbstractInputGenerator):
           model.preprocessor.get_in_feature_specification(mode),
           model.preprocessor.get_in_label_specification(mode))
     else:
-      self._base.set_specification_from_model(model, mode)
-      self.set_specification(self._base.feature_spec,
-                             self._base.label_spec)
+      raise ValueError(
+          "MetaExampleInputGenerator requires a meta model exposing "
+          "`base_model` (e.g. MAMLModel); a flat model would declare "
+          "flat specs while this generator yields nested meta batches.")
 
   def _create_dataset(self, mode: Mode, batch_size: int
                       ) -> Iterator[Tuple[TensorSpecStruct,

@@ -1,0 +1,15 @@
+"""QT-Opt research family (reference: tensor2robot research/qtopt/)."""
+
+from tensor2robot_tpu.research.qtopt.cem import (
+    CEMResult,
+    cem_maximize,
+    make_q_score_fn,
+)
+from tensor2robot_tpu.research.qtopt.networks import GraspingQNetwork
+from tensor2robot_tpu.research.qtopt.qtopt_learner import (
+    QTOptLearner,
+    QTOptState,
+)
+from tensor2robot_tpu.research.qtopt.replay_buffer import ReplayBuffer
+from tensor2robot_tpu.research.qtopt.t2r_models import GraspingQModel
+from tensor2robot_tpu.research.qtopt.train_qtopt import train_qtopt

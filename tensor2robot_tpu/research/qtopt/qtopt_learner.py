@@ -1,0 +1,202 @@
+"""QT-Opt learner: Bellman targets via on-device CEM + critic updates.
+
+The reference open-sourced only the grasping model and the export/
+predict handoff — its distributed system (replay buffer service,
+Bellman updater fleet, CEM policy server; SURVEY.md §3 parallelism
+inventory "Async actor/learner distribution") stayed in Google infra.
+This module IS that system, collapsed into a single XLA program per
+step, which is what the hardware wants:
+
+  one jitted `train_step(learner_state, transitions)`:
+    1. CEM-maximize Q_target(s', ·) for the whole batch (population
+       folded into the batch dim — every eval saturates the MXU),
+    2. target = r + γ (1-done) max_a' Q_target(s', a'), clipped to
+       [0, 1] for the sigmoid grasp-success head (paper's form),
+    3. cross-entropy critic update on Q(s, a),
+    4. Polyak (or periodic) target-network update.
+
+Data parallel over the mesh: batch sharded on the data axis, params
+replicated, GSPMD all-reduces gradients over ICI — the same step scales
+from 1 chip to a v5e-64 pod unchanged.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import flax
+import jax
+import jax.numpy as jnp
+import optax
+
+from tensor2robot_tpu import config as gin
+from tensor2robot_tpu.data.abstract_input_generator import Mode
+from tensor2robot_tpu.models.abstract_model import TrainState
+from tensor2robot_tpu.models.critic_model import Q_VALUE
+from tensor2robot_tpu.research.qtopt import cem
+from tensor2robot_tpu.research.qtopt.t2r_models import GraspingQModel
+from tensor2robot_tpu.specs import TensorSpecStruct
+
+
+@flax.struct.dataclass
+class QTOptState:
+  """Learner state: critic TrainState + target network params."""
+
+  train_state: TrainState
+  target_params: Any
+
+  @property
+  def step(self):
+    return self.train_state.step
+
+
+@gin.configurable
+class QTOptLearner:
+  """Builds the jittable QT-Opt training step for a GraspingQModel."""
+
+  def __init__(self,
+               model: GraspingQModel,
+               gamma: float = 0.9,
+               cem_iterations: int = 2,
+               cem_population: int = 64,
+               cem_elites: int = 6,
+               action_low: float = -1.0,
+               action_high: float = 1.0,
+               target_update_tau: float = 0.05,
+               clip_targets: Optional[Tuple[float, float]] = (0.0, 1.0)):
+    self._model = model
+    self._gamma = gamma
+    self._cem_iterations = cem_iterations
+    self._cem_population = cem_population
+    self._cem_elites = cem_elites
+    self._action_low = action_low
+    self._action_high = action_high
+    self._tau = target_update_tau
+    self._clip_targets = clip_targets if model.sigmoid_q else None
+
+  @property
+  def model(self) -> GraspingQModel:
+    return self._model
+
+  def create_state(self, rng: jax.Array,
+                   batch_size: int = 2) -> QTOptState:
+    train_state = self._model.create_train_state(rng, batch_size)
+    # Materialize a distinct copy: aliasing the online params would make
+    # donated train_step inputs share buffers (donation error).
+    target = jax.tree_util.tree_map(jnp.copy, train_state.params)
+    return QTOptState(train_state=train_state, target_params=target)
+
+  # ---- target computation ----
+
+  def _target_q_values(self, target_params, batch_stats,
+                       next_features: TensorSpecStruct,
+                       rng: jax.Array) -> jax.Array:
+    """max_a' Q_target(s', a') via CEM, one XLA region."""
+    variables = {"params": target_params}
+    if batch_stats:
+      variables["batch_stats"] = batch_stats
+    batch = jax.tree_util.tree_leaves(next_features)[0].shape[0]
+    score_fn = cem.make_q_score_fn(
+        functools.partial(self._model.network.apply),
+        variables, next_features, q_key=Q_VALUE)
+
+    def sigmoid_score(actions):
+      q = score_fn(actions)
+      return jax.nn.sigmoid(q) if self._model.sigmoid_q else q
+
+    result = cem.cem_maximize(
+        sigmoid_score, rng, batch, self._model.action_dim,
+        iterations=self._cem_iterations,
+        population=self._cem_population,
+        num_elites=self._cem_elites,
+        low=self._action_low, high=self._action_high)
+    return result.best_score
+
+  # ---- the fused train step ----
+
+  def train_step(self, state: QTOptState, transitions: TensorSpecStruct,
+                 rng: jax.Array) -> Tuple[QTOptState,
+                                          Dict[str, jax.Array]]:
+    """One Bellman update on a batch of transitions.
+
+    transitions (flat struct): image, action [A], reward [1], done [1],
+    next_image (+ any extra state features prefixed next_).
+    """
+    flat = transitions.to_flat_dict()
+    rng_cem, rng_net = jax.random.split(rng)
+
+    features = TensorSpecStruct.from_flat_dict({
+        "image": flat["image"], "action": flat["action"]})
+    next_features = TensorSpecStruct.from_flat_dict(
+        {k[len("next_"):]: v for k, v in flat.items()
+         if k.startswith("next_")})
+
+    ts = state.train_state
+    q_next = self._target_q_values(
+        state.target_params, ts.batch_stats, next_features, rng_cem)
+    reward = flat["reward"].reshape(-1).astype(jnp.float32)
+    done = flat["done"].reshape(-1).astype(jnp.float32)
+    target = reward + self._gamma * (1.0 - done) * q_next
+    if self._clip_targets is not None:
+      target = jnp.clip(target, *self._clip_targets)
+    target = jax.lax.stop_gradient(target)
+
+    labels = TensorSpecStruct.from_flat_dict(
+        {"target_q": target[:, None]})
+    new_ts, metrics = self._model.train_step(ts, features, labels,
+                                             rng_net)
+    new_target = optax.incremental_update(
+        new_ts.params, state.target_params, self._tau)
+    metrics["q_next_mean"] = jnp.mean(q_next)
+    metrics["target_mean"] = jnp.mean(target)
+    return QTOptState(train_state=new_ts,
+                      target_params=new_target), metrics
+
+  # ---- on-robot / actor policy ----
+
+  def build_policy(self, cem_population: Optional[int] = None,
+                   cem_iterations: Optional[int] = None):
+    """Returns a jittable (state, observation_features, rng) → action.
+
+    The serving-side CEM: the reference's robots looped predict() calls
+    host-side; here action selection is one device program.
+    """
+    population = cem_population or self._cem_population
+    iterations = cem_iterations or self._cem_iterations
+
+    def policy(state: QTOptState, observations: TensorSpecStruct,
+               rng: jax.Array) -> jax.Array:
+      ts = state.train_state
+      variables = {"params": ts.params}
+      if ts.batch_stats:
+        variables["batch_stats"] = ts.batch_stats
+      batch = jax.tree_util.tree_leaves(observations)[0].shape[0]
+      score_fn = cem.make_q_score_fn(
+          functools.partial(self._model.network.apply),
+          variables, observations, q_key=Q_VALUE)
+      result = cem.cem_maximize(
+          score_fn, rng, batch, self._model.action_dim,
+          iterations=iterations, population=population,
+          num_elites=self._cem_elites,
+          low=self._action_low, high=self._action_high)
+      return result.best_action
+
+    return policy
+
+  def transition_specification(self) -> TensorSpecStruct:
+    """The replay-buffer transition spec, derived from the model specs."""
+    import numpy as np
+    from tensor2robot_tpu.specs import ExtendedTensorSpec
+
+    model_feat = self._model.get_feature_specification(
+        Mode.TRAIN).to_flat_dict()
+    out = dict(model_feat)
+    for key, spec in model_feat.items():
+      if key != "action":
+        out[f"next_{key}"] = spec.replace(name=f"next_{spec.name or key}")
+    out["reward"] = ExtendedTensorSpec(shape=(1,), dtype=np.float32,
+                                       name="reward")
+    out["done"] = ExtendedTensorSpec(shape=(1,), dtype=np.float32,
+                                     name="done")
+    return TensorSpecStruct.from_flat_dict(out)

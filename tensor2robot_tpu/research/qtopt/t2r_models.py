@@ -1,0 +1,85 @@
+"""QT-Opt grasping critic model: specs + network wiring.
+
+Reference parity: tensor2robot `research/qtopt/t2r_models.py` — the
+TPU-ready grasping Q-model declaring image/action specs over the
+critic base (SURVEY.md §3 "QT-Opt models"; file:line unavailable —
+empty reference mount). The distributed QT-Opt system around it (replay
+buffer, Bellman updaters, CEM policy) was NOT in the reference repo;
+here it IS in-repo — see qtopt_learner.py / replay_buffer.py — because
+the north-star target is training throughput of the full loop.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu import config as gin
+from tensor2robot_tpu.data.abstract_input_generator import Mode
+from tensor2robot_tpu.models.critic_model import CriticModel
+from tensor2robot_tpu.research.qtopt.networks import GraspingQNetwork
+from tensor2robot_tpu.specs import ExtendedTensorSpec, TensorSpecStruct
+
+
+@gin.configurable
+class GraspingQModel(CriticModel):
+  """Q(image, action) with sigmoid grasp-success head.
+
+  Wire spec: uint8 camera image + float action (gripper pose delta +
+  open/close + terminate, 4-7 dims in the paper). The Bellman target
+  label `target_q` is produced by the learner, not the dataset.
+  """
+
+  def __init__(self,
+               image_size: int = 64,
+               action_dim: int = 4,
+               torso_filters: Sequence[int] = (32, 64),
+               head_filters: Sequence[int] = (64, 64),
+               dense_sizes: Sequence[int] = (64, 64),
+               use_batch_norm: bool = True,
+               sigmoid_q: bool = True,
+               device_dtype=jnp.bfloat16,
+               **kwargs):
+    super().__init__(sigmoid_q=sigmoid_q, target_q_key="target_q",
+                     device_dtype=device_dtype, **kwargs)
+    self._image_size = image_size
+    self._action_dim = action_dim
+    self._torso_filters = tuple(torso_filters)
+    self._head_filters = tuple(head_filters)
+    self._dense_sizes = tuple(dense_sizes)
+    self._use_batch_norm = use_batch_norm
+
+  @property
+  def action_dim(self) -> int:
+    return self._action_dim
+
+  @property
+  def image_size(self) -> int:
+    return self._image_size
+
+  def get_feature_specification(self, mode: Mode) -> TensorSpecStruct:
+    st = TensorSpecStruct()
+    st.image = ExtendedTensorSpec(
+        shape=(self._image_size, self._image_size, 3), dtype=np.uint8,
+        name="image", data_format="jpeg")
+    st.action = ExtendedTensorSpec(
+        shape=(self._action_dim,), dtype=np.float32, name="action")
+    return st
+
+  def get_label_specification(self, mode: Mode) -> TensorSpecStruct:
+    st = TensorSpecStruct()
+    st.target_q = ExtendedTensorSpec(
+        shape=(1,), dtype=np.float32, name="target_q")
+    return st
+
+  def create_network(self) -> nn.Module:
+    return GraspingQNetwork(
+        torso_filters=self._torso_filters,
+        head_filters=self._head_filters,
+        dense_sizes=self._dense_sizes,
+        use_batch_norm=self._use_batch_norm,
+        dtype=self.device_dtype,
+    )

@@ -85,6 +85,54 @@ class TestSpecsAndData:
         Mode.TRAIN).to_flat_dict()
     assert "condition_labels/target" not in train_flat
 
+  def test_base_preprocessor_lifts_over_splits(self):
+    # A base model with a real wire!=model preprocessor: the meta wire
+    # spec must reflect the BASE IN spec, and preprocess must produce
+    # model-side shapes per split.
+    from functools import partial
+    from tensor2robot_tpu.preprocessors.image_preprocessor import (
+        ImagePreprocessor,
+    )
+    from tensor2robot_tpu.research.pose_env import (
+        PoseEnvRegressionModel,
+    )
+
+    base = PoseEnvRegressionModel(
+        image_size=16, filters=(8,), embedding_size=16,
+        hidden_sizes=(8,), use_batch_norm=False,
+        preprocessor_cls=partial(ImagePreprocessor, src_height=20,
+                                 src_width=20, distort=False))
+    model = MAMLModel(base_model=base,
+                      num_condition_samples_per_task=2,
+                      num_inference_samples_per_task=3)
+    wire = model.preprocessor.get_in_feature_specification(
+        Mode.TRAIN).to_flat_dict()
+    assert wire["condition/image"].shape == (2, 20, 20, 3)
+    assert wire["inference/image"].shape == (3, 20, 20, 3)
+    assert wire["condition/image"].dtype == np.uint8
+    # The nested image spec must be raw on the wire (no jpeg format).
+    assert wire["condition/image"].data_format is None
+
+    from tensor2robot_tpu.specs import make_random_tensors
+    feats = make_random_tensors(
+        model.preprocessor.get_in_feature_specification(Mode.TRAIN),
+        batch_size=4, seed=0)
+    labels = make_random_tensors(
+        model.preprocessor.get_in_label_specification(Mode.TRAIN),
+        batch_size=4, seed=1)
+    feats = jax.tree_util.tree_map(jnp.asarray, feats)
+    labels = jax.tree_util.tree_map(jnp.asarray, labels)
+    out_f, out_l = model.preprocessor.preprocess(
+        feats, labels, Mode.TRAIN, jax.random.PRNGKey(0))
+    flat = out_f.to_flat_dict()
+    assert flat["condition/image"].shape == (4, 2, 16, 16, 3)
+    assert flat["inference/image"].shape == (4, 3, 16, 16, 3)
+    # And the full train step runs through the lifted preprocessor.
+    state = model.create_train_state(jax.random.PRNGKey(0))
+    state, metrics = jax.jit(model.train_step)(
+        state, feats, labels, jax.random.PRNGKey(1))
+    assert np.isfinite(float(metrics["loss"]))
+
   def test_eval_step_runs(self):
     model = _meta_model()
     state = model.create_train_state(jax.random.PRNGKey(0))
@@ -109,7 +157,8 @@ class TestSpecsAndData:
 class TestMAMLTraining:
 
   def test_train_step_runs_and_reports_adaptation(self):
-    model = _meta_model(num_inner_steps=2, inner_lr=0.05)
+    model = _meta_model(num_inner_steps=2, inner_lr=0.05,
+                        report_pre_adaptation_loss=True)
     state = model.create_train_state(jax.random.PRNGKey(0))
     gen = MetaExampleInputGenerator(
         RandomInputGenerator(), batch_size=8,
@@ -169,6 +218,7 @@ class TestMAMLTraining:
         num_inner_steps=3, inner_lr=0.1,
         num_condition_samples_per_task=8,
         num_inference_samples_per_task=8,
+        report_pre_adaptation_loss=True,
         create_optimizer_fn=lambda: opt_lib.create_optimizer(
             optimizer_name="adam", learning_rate=1e-3),
     )

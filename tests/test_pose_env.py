@@ -82,7 +82,7 @@ class TestPoseEnvEndToEnd:
         model=model,
         model_dir=model_dir,
         input_generator_train=TFRecordInputGenerator(
-            file_patterns=data_path, shuffle_buffer_size=64),
+            file_patterns=data_path, shuffle_buffer_size=64, seed=1),
         input_generator_eval=TFRecordInputGenerator(
             file_patterns=data_path, shuffle=False, repeat=False),
         max_train_steps=40,
