@@ -24,9 +24,25 @@ def sanitize_signature_key(key: str) -> str:
   """Flat spec key → TF signature tensor name (no '/' allowed).
 
   This is a WIRE CONTRACT between exporters and SavedModel predictors;
-  both sides must use this one helper.
+  both sides must use this one helper. The mapping is not injective
+  ('a/b' and 'a_b' collide) — exporters must call
+  `check_signature_keys` over the full key set so a colliding spec
+  fails loudly at export time instead of producing an ambiguous feed.
   """
   return key.replace("/", "_")
+
+
+def check_signature_keys(keys) -> None:
+  """Raises if two flat spec keys sanitize to the same tensor name."""
+  seen = {}
+  for key in keys:
+    name = sanitize_signature_key(key)
+    if name in seen and seen[name] != key:
+      raise ValueError(
+          f"Flat spec keys {seen[name]!r} and {key!r} both sanitize to "
+          f"signature name {name!r}; rename one — the SavedModel feed "
+          "would be ambiguous.")
+    seen[name] = key
 
 
 def claim_timestamped_export_dir(export_dir_base: str) -> tuple:

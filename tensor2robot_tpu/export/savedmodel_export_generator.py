@@ -32,6 +32,7 @@ from tensor2robot_tpu.data import tfexample
 from tensor2robot_tpu.data.abstract_input_generator import Mode
 from tensor2robot_tpu.export.abstract_export_generator import (
     AbstractExportGenerator,
+    check_signature_keys,
     claim_timestamped_export_dir,
     sanitize_signature_key,
 )
@@ -95,6 +96,7 @@ class SavedModelExportGenerator(AbstractExportGenerator):
 
     # Signature tensor names cannot contain '/', so nested flat keys
     # (a/b/c) are sanitized; predictors apply the same mapping.
+    check_signature_keys(flat_specs)
     input_sigs = {
         key: tf.TensorSpec([batch_dim] + list(spec.shape),
                            _tf_dtype(tf, spec),

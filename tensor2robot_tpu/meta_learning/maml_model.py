@@ -139,11 +139,22 @@ class MAMLPreprocessor:
     out_f, out_l = {}, {}
     flat_features = features.to_flat_dict()
     has_labels = labels is not None
+    demo_prefix = CONDITION_LABELS + "/"
+    demo_keys = [k for k in flat_features if k.startswith(demo_prefix)]
     rngs = (_jax.random.split(rng, 2) if rng is not None
             else (None, None))
     for i, (split, n) in enumerate(self._splits()):
       f = _split(features, split)
       l = _split(labels, split) if has_labels else None
+      # Predict-time demonstration labels must ride the SAME base label
+      # path as training labels (dtype casts, scaling): _adapt compares
+      # preprocessed network outputs against them, so feeding them raw
+      # would skew adaptation whenever the base preprocessor transforms
+      # labels.
+      demo_as_labels = (split == CONDITION and demo_keys and l is None)
+      if demo_as_labels:
+        l = TensorSpecStruct.from_flat_dict(
+            {k[len(demo_prefix):]: flat_features[k] for k in demo_keys})
 
       num_tasks = _jax.tree_util.tree_leaves(f)[0].shape[0]
 
@@ -160,11 +171,16 @@ class MAMLPreprocessor:
       for key, value in f2.to_flat_dict().items():
         out_f[f"{split}/{key}"] = unfold(value)
       if l2 is not None:
-        for key, value in l2.to_flat_dict().items():
-          out_l[f"{split}/{key}"] = unfold(value)
-    # Demonstration labels (predict-time adaptation data) pass through.
+        if demo_as_labels:
+          for key, value in l2.to_flat_dict().items():
+            out_f[f"{CONDITION_LABELS}/{key}"] = unfold(value)
+        else:
+          for key, value in l2.to_flat_dict().items():
+            out_l[f"{split}/{key}"] = unfold(value)
+    # Anything not handled above (labels already supplied alongside
+    # demonstrations) passes through unchanged.
     for key, value in flat_features.items():
-      if key.startswith(CONDITION_LABELS + "/"):
+      if key.startswith(demo_prefix) and key not in out_f:
         out_f[key] = value
     features_out = TensorSpecStruct.from_flat_dict(out_f)
     labels_out = TensorSpecStruct.from_flat_dict(out_l) if out_l else \

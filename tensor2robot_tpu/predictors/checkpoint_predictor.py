@@ -72,9 +72,16 @@ class CheckpointPredictor(AbstractPredictor):
         subdir="params")
     if step is None:
       return self._restored_step >= 0
-    params = ckpt_lib.restore_params(
-        self._checkpoint_dir, like=self._state.params, step=step)
-    self._state = self._state.replace(params=params)
+    # Restore params AND batch-norm stats: serving with fresh-init
+    # moving averages silently degrades BN models.
+    variables = ckpt_lib.restore_variables(
+        self._checkpoint_dir,
+        like={"params": self._state.params,
+              "batch_stats": self._state.batch_stats},
+        step=step)
+    self._state = self._state.replace(
+        params=variables["params"],
+        batch_stats=variables.get("batch_stats", {}))
     self._restored_step = step
     return True
 

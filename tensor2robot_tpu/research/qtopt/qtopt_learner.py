@@ -126,8 +126,13 @@ class QTOptLearner:
     flat = transitions.to_flat_dict()
     rng_cem, rng_net = jax.random.split(rng)
 
+    # Every non-next_, non-reward/done key is an online-critic feature:
+    # models with state extras beyond {image, action} (gripper status,
+    # height, ...) must see them in Q(s, a) just as the target network
+    # sees their next_-prefixed twins.
     features = TensorSpecStruct.from_flat_dict({
-        "image": flat["image"], "action": flat["action"]})
+        k: v for k, v in flat.items()
+        if not k.startswith("next_") and k not in ("reward", "done")})
     next_features = TensorSpecStruct.from_flat_dict(
         {k[len("next_"):]: v for k, v in flat.items()
          if k.startswith("next_")})

@@ -29,8 +29,10 @@ class GraspingQModel(CriticModel):
   """Q(image, action) with sigmoid grasp-success head.
 
   Wire spec: uint8 camera image + float action (gripper pose delta +
-  open/close + terminate, 4-7 dims in the paper). The Bellman target
-  label `target_q` is produced by the learner, not the dataset.
+  open/close + terminate, 4-7 dims in the paper) + optional extra state
+  vectors (gripper aperture, height, ... — the paper's non-image state)
+  declared via `extra_state_features`. The Bellman target label
+  `target_q` is produced by the learner, not the dataset.
   """
 
   def __init__(self,
@@ -39,6 +41,7 @@ class GraspingQModel(CriticModel):
                torso_filters: Sequence[int] = (32, 64),
                head_filters: Sequence[int] = (64, 64),
                dense_sizes: Sequence[int] = (64, 64),
+               extra_state_features=None,
                use_batch_norm: bool = True,
                sigmoid_q: bool = True,
                device_dtype=jnp.bfloat16,
@@ -50,6 +53,9 @@ class GraspingQModel(CriticModel):
     self._torso_filters = tuple(torso_filters)
     self._head_filters = tuple(head_filters)
     self._dense_sizes = tuple(dense_sizes)
+    # {name: shape} of float state vectors fed to Q(s, a) alongside the
+    # action embedding (the network concatenates every float extra).
+    self._extra_state_features = dict(extra_state_features or {})
     self._use_batch_norm = use_batch_norm
 
   @property
@@ -67,6 +73,9 @@ class GraspingQModel(CriticModel):
         name="image", data_format="jpeg")
     st.action = ExtendedTensorSpec(
         shape=(self._action_dim,), dtype=np.float32, name="action")
+    for key, shape in self._extra_state_features.items():
+      st[key] = ExtendedTensorSpec(
+          shape=tuple(shape), dtype=np.float32, name=key)
     return st
 
   def get_label_specification(self, mode: Mode) -> TensorSpecStruct:

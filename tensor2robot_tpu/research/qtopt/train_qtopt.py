@@ -118,13 +118,15 @@ def train_qtopt(
       if step % save_checkpoints_steps == 0 or step == max_train_steps:
         host_state = jax.device_get(state)
         writer.save(step, host_state,
-                    params=host_state.train_state.params)
+                    params=host_state.train_state.params,
+                    batch_stats=host_state.train_state.batch_stats)
         last_saved = step
         hook_list.after_checkpoint(step, state.train_state, model_dir)
     if last_saved != step:
       host_state = jax.device_get(state)
       writer.save(step, host_state,
-                  params=host_state.train_state.params)
+                  params=host_state.train_state.params,
+                  batch_stats=host_state.train_state.batch_stats)
       hook_list.after_checkpoint(step, state.train_state, model_dir)
     hook_list.end(step, state.train_state, model_dir)
   finally:

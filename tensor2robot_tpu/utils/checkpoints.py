@@ -9,10 +9,13 @@ target abstract pytree carries — checkpoints move freely between mesh
 shapes).
 
 Layout: `<model_dir>/ckpt/<step>/{state,params}` — `state` is the full
-TrainState pytree; `params` duplicates the (small, CNN-scale) parameter
-subtree so warm-start and predictors can restore params without knowing
-the optimizer. A `<step>` directory is only visible once finalized
-(orbax writes atomically), so pollers never see partial checkpoints.
+TrainState pytree; `params` duplicates the (small, CNN-scale) inference
+variables `{"params": ..., "batch_stats": ...}` so warm-start and
+predictors can restore serving weights — INCLUDING batch-norm moving
+averages, which the reference's full-checkpoint restore also carried —
+without knowing the optimizer. A `<step>` directory is only visible
+once finalized (orbax writes atomically), so pollers never see partial
+checkpoints.
 """
 
 from __future__ import annotations
@@ -76,17 +79,25 @@ class CheckpointWriter:
     self._pending_steps: set = set()
 
   def save(self, step: int, state: Any, params: Optional[Any] = None,
-           force: bool = False) -> None:
+           batch_stats: Optional[Any] = None, force: bool = False) -> None:
     step_dir = os.path.join(self._root, str(int(step)))
     self._checkpointer.save(
         os.path.join(step_dir, "state"),
         args=ocp.args.StandardSave(state), force=force)
     if params is None:
       params = getattr(state, "params", None)
+    if batch_stats is None:
+      # Callers that pass params explicitly still get their BN stats
+      # saved — losing them silently is the bug this payload fixes.
+      batch_stats = getattr(state, "batch_stats", None)
     if params is not None:
+      # Inference payload: params AND batch-norm statistics. Serving a
+      # BN model with fresh-init stats silently degrades predictions,
+      # so the stats ride with the weights.
+      variables = {"params": params, "batch_stats": batch_stats or {}}
       self._params_checkpointer.save(
           os.path.join(step_dir, "params"),
-          args=ocp.args.StandardSave(params), force=force)
+          args=ocp.args.StandardSave(variables), force=force)
     self._pending_steps.add(int(step))
     self._gc()
 
@@ -144,13 +155,8 @@ def restore_state(model_dir: str, like: Any,
     return checkpointer.restore(path, _abstract_like(like))
 
 
-def restore_params(path_or_model_dir: str, like: Any,
-                   step: Optional[int] = None) -> Any:
-  """Restores just params — for warm starts and predictors.
-
-  Accepts either a model_dir (picks latest step), a step dir, or a
-  direct params checkpoint path.
-  """
+def _find_params_path(path_or_model_dir: str,
+                      step: Optional[int] = None) -> str:
   candidates = []
   if step is not None:
     candidates.append(os.path.join(
@@ -164,10 +170,75 @@ def restore_params(path_or_model_dir: str, like: Any,
     candidates.append(path_or_model_dir)
   for path in candidates:
     if os.path.isdir(path):
-      with ocp.StandardCheckpointer() as checkpointer:
-        return checkpointer.restore(path, _abstract_like(like))
+      return path
   raise FileNotFoundError(
       f"No params checkpoint found at any of: {candidates}")
+
+
+def _is_variables_payload(tree: Any) -> bool:
+  return (isinstance(tree, dict)
+          and "params" in tree
+          and set(tree) <= {"params", "batch_stats"})
+
+
+def _adopt_like(like: Any, restored: Any) -> Any:
+  """Host-restored leaves adopt `like`'s dtypes and shardings."""
+
+  def leaf(l, x):
+    if isinstance(l, jax.Array):
+      return jax.device_put(jax.numpy.asarray(x, l.dtype), l.sharding)
+    return np.asarray(x)
+
+  return jax.tree_util.tree_map(leaf, like, restored)
+
+
+def restore_variables(path_or_model_dir: str, like: Any,
+                      step: Optional[int] = None) -> Any:
+  """Restores the inference payload `{"params", "batch_stats"}`.
+
+  `like` must be a dict with "params" and "batch_stats" entries (the
+  latter may be an empty dict); restored arrays adopt its shardings.
+  Predictors use this so BN moving averages survive the
+  trainer→predictor handoff — the reference restored full checkpoints,
+  moving averages included. Payloads written before batch_stats rode
+  along (bare params trees) still restore; their stats fall back to
+  `like`'s (the old, stale-stats behavior, with a warning).
+  """
+  path = _find_params_path(path_or_model_dir, step)
+  with ocp.StandardCheckpointer() as checkpointer:
+    restored = checkpointer.restore(path)
+  if not _is_variables_payload(restored):
+    import logging
+    logging.getLogger(__name__).warning(
+        "Params payload at %s predates batch_stats bundling; BN stats "
+        "keep their current (init) values.", path)
+    restored = {"params": restored, "batch_stats": None}
+  out = {"params": _adopt_like(like["params"], restored["params"])}
+  like_stats = like.get("batch_stats", {})
+  restored_stats = restored.get("batch_stats")
+  if restored_stats:
+    out["batch_stats"] = _adopt_like(like_stats, restored_stats)
+  else:
+    out["batch_stats"] = like_stats
+  return out
+
+
+def restore_params(path_or_model_dir: str, like: Any,
+                   step: Optional[int] = None) -> Any:
+  """Restores just params — for warm starts.
+
+  `like` is the params subtree alone. The payload also carries
+  batch_stats, whose structure the caller may not know, so the payload
+  is read target-free and the params subtree extracted; leaves then
+  adopt `like`'s shardings. Accepts a model_dir (picks latest step), a
+  step dir, or a direct params checkpoint path.
+  """
+  path = _find_params_path(path_or_model_dir, step)
+  with ocp.StandardCheckpointer() as checkpointer:
+    restored = checkpointer.restore(path)
+  if _is_variables_payload(restored):
+    restored = restored["params"]
+  return _adopt_like(like, restored)
 
 
 def wait_for_new_checkpoint(

@@ -112,9 +112,10 @@ class TestPoseEnvEndToEnd:
     metrics = evaluate_pose_model(
         predictor.predict, num_episodes=8, image_size=32)
     assert set(metrics) >= {"mean_pose_error", "success_rate"}
-    # 40 steps is enough to beat the ~0.33 random-guess distance on
-    # this toy task, at least loosely.
-    assert metrics["mean_pose_error"] < 0.5
+    # Always predicting the workspace center scores ~0.31 on uniform
+    # ±0.4 poses; the bar sits below that so a predictor serving
+    # garbage (e.g. unrestored batch-norm stats) fails here.
+    assert metrics["mean_pose_error"] < 0.25
 
   def test_random_generator_also_works(self, tmp_path):
     model = _tiny_model()
