@@ -10,7 +10,9 @@ from tensor2robot_tpu.data.random_input_generator import (
 )
 from tensor2robot_tpu.data.tfrecord_input_generator import (
     DefaultRecordInputGenerator,
+    TFRecordEpisodeInputGenerator,
     TFRecordInputGenerator,
+    write_episode_tfrecord,
     write_tfrecord,
 )
 from tensor2robot_tpu.data.prefetch import (

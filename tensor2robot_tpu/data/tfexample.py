@@ -53,7 +53,9 @@ def build_feature_map(feature_spec: Any) -> Dict[str, Any]:
     if spec.is_sequence:
       raise ValueError(
           f"Sequence spec {name!r} cannot be bound to a tf.Example wire "
-          f"directly; materialize a fixed length first via "
+          f"directly; episode data travels as tf.SequenceExample — use "
+          f"parse_sequence_example_batch / encode_sequence_example — or "
+          f"materialize a fixed length first via "
           f"specs.add_sequence_length (XLA needs static shapes).")
     if spec.varlen:
       # Ragged on the wire; padded/truncated to the static shape at parse
@@ -144,6 +146,28 @@ def _pad_or_truncate(
   return dense.reshape(target).astype(spec.dtype)
 
 
+def _encode_feature(value: Any, spec: ExtendedTensorSpec) -> Any:
+  """Encodes ONE unbatched value as a tf.train.Feature per its spec."""
+  tf = _tf()
+  if spec.is_image:
+    if isinstance(value, (bytes, np.bytes_)):
+      data = bytes(value)
+    else:
+      arr = np.ascontiguousarray(np.asarray(value, dtype=np.uint8))
+      if spec.data_format == "png":
+        data = tf.io.encode_png(arr).numpy()
+      else:
+        data = tf.io.encode_jpeg(arr).numpy()
+    return tf.train.Feature(bytes_list=tf.train.BytesList(value=[data]))
+  arr = np.asarray(value).reshape(-1)
+  dtype = np.dtype(spec.dtype)
+  if dtype.kind == "f" or spec.dtype.name == "bfloat16":
+    return tf.train.Feature(
+        float_list=tf.train.FloatList(value=arr.astype(np.float32)))
+  return tf.train.Feature(
+      int64_list=tf.train.Int64List(value=arr.astype(np.int64)))
+
+
 def encode_example(
     flat_tensors: Dict[str, np.ndarray],
     feature_spec: Any,
@@ -163,27 +187,193 @@ def encode_example(
       if spec.is_optional:
         continue
       raise ValueError(f"Missing required feature {key!r}")
-    value = flat_tensors[key]
-    if spec.is_image:
-      if isinstance(value, (bytes, np.bytes_)):
-        data = bytes(value)
-      else:
-        arr = np.ascontiguousarray(np.asarray(value, dtype=np.uint8))
-        if spec.data_format == "png":
-          data = tf.io.encode_png(arr).numpy()
-        else:
-          data = tf.io.encode_jpeg(arr).numpy()
-      feature[name] = tf.train.Feature(
-          bytes_list=tf.train.BytesList(value=[data]))
-      continue
-    arr = np.asarray(value).reshape(-1)
-    dtype = np.dtype(spec.dtype)
-    if dtype.kind == "f" or spec.dtype.name == "bfloat16":
-      feature[name] = tf.train.Feature(
-          float_list=tf.train.FloatList(value=arr.astype(np.float32)))
-    else:
-      feature[name] = tf.train.Feature(
-          int64_list=tf.train.Int64List(value=arr.astype(np.int64)))
+    feature[name] = _encode_feature(flat_tensors[key], spec)
   example = tf.train.Example(
       features=tf.train.Features(feature=feature))
   return example.SerializeToString()
+
+
+# ---- episode wire format: tf.SequenceExample ----
+#
+# Reference parity: the reference parsed robot episodes (short per-task
+# demonstration/trial sequences; SURVEY.md §3 `meta_tfdata.py`, §6
+# "sequences are short robot episodes"). Per-episode data splits into
+# context (is_sequence=False: task ids, goals) and per-timestep
+# feature_lists (is_sequence=True: observations, actions). Episodes are
+# ragged on the wire; parse pads/truncates every sequence to a caller-
+# fixed length — XLA needs static shapes — and reports true lengths.
+
+
+def split_sequence_specs(feature_spec: Any):
+  """Splits a spec structure into (context, sequence) flat dicts."""
+  flat = specs.flatten_spec_structure(feature_spec).to_flat_dict()
+  context = {k: s for k, s in flat.items() if not s.is_sequence}
+  sequence = {k: s for k, s in flat.items() if s.is_sequence}
+  return context, sequence
+
+
+def build_sequence_feature_maps(feature_spec: Any):
+  """(context_map, sequence_map) for tf.io.parse_sequence_example."""
+  tf = _tf()
+  context_specs, sequence_specs = split_sequence_specs(feature_spec)
+  context_map = build_feature_map(
+      TensorSpecStruct.from_flat_dict(context_specs)) if context_specs \
+      else {}
+  sequence_map = {}
+  for key, spec in sequence_specs.items():
+    name = wire_key(key, spec)
+    if spec.is_image:
+      sequence_map[name] = tf.io.FixedLenSequenceFeature([], tf.string)
+      continue
+    dtype = np.dtype(spec.dtype)
+    if dtype.kind == "f" or spec.dtype.name == "bfloat16":
+      tf_dtype = tf.float32
+    elif dtype.kind in ("i", "u", "b"):
+      tf_dtype = tf.int64
+    else:
+      raise ValueError(
+          f"Unsupported sequence spec dtype for tf.SequenceExample: "
+          f"{dtype}")
+    sequence_map[name] = tf.io.FixedLenSequenceFeature(
+        [int(np.prod(spec.shape))], tf_dtype)
+  return context_map, sequence_map
+
+
+def encode_sequence_example(
+    flat_tensors: Dict[str, np.ndarray],
+    feature_spec: Any,
+) -> bytes:
+  """Encodes ONE episode as a serialized tf.SequenceExample.
+
+  Sequence specs expect [T, ...] arrays (T may differ per episode —
+  ragged on the wire); image sequence specs accept [T, H, W, C] uint8
+  (each frame encoded) or a list of pre-encoded byte strings. Context
+  specs expect unbatched arrays, as in `encode_example`.
+  """
+  tf = _tf()
+  context_specs, sequence_specs = split_sequence_specs(feature_spec)
+  if not sequence_specs:
+    raise ValueError(
+        "encode_sequence_example needs at least one is_sequence spec; "
+        "use encode_example for flat records.")
+
+  context = {}
+  for key, spec in context_specs.items():
+    name = wire_key(key, spec)
+    if key not in flat_tensors:
+      if spec.is_optional:
+        continue
+      raise ValueError(f"Missing required context feature {key!r}")
+    context[name] = _encode_feature(flat_tensors[key], spec)
+
+  lengths = set()
+  feature_lists = {}
+  for key, spec in sequence_specs.items():
+    name = wire_key(key, spec)
+    if key not in flat_tensors:
+      if spec.is_optional:
+        continue
+      raise ValueError(f"Missing required sequence feature {key!r}")
+    steps = flat_tensors[key]
+    lengths.add(len(steps))
+    step_spec = spec.replace(is_sequence=False)
+    feature_lists[name] = tf.train.FeatureList(
+        feature=[_encode_feature(step, step_spec) for step in steps])
+  if len(lengths) > 1:
+    raise ValueError(
+        f"All sequence features of one episode must share a length; "
+        f"got lengths {sorted(lengths)}.")
+
+  example = tf.train.SequenceExample(
+      context=tf.train.Features(feature=context),
+      feature_lists=tf.train.FeatureLists(feature_list=feature_lists))
+  return example.SerializeToString()
+
+
+SEQUENCE_LENGTH_KEY = "sequence_length"
+
+
+def parse_sequence_example_batch(
+    serialized: Any,
+    feature_spec: Any,
+    sequence_length: int,
+) -> TensorSpecStruct:
+  """Parses serialized tf.SequenceExamples into static-shape numpy.
+
+  Returns a flat TensorSpecStruct where sequence keys hold
+  [batch, sequence_length] + spec.shape arrays (zero-padded / truncated
+  — episodes are ragged on the wire, XLA shapes are static), context
+  keys hold [batch] + spec.shape arrays, and `SEQUENCE_LENGTH_KEY`
+  holds the TRUE pre-pad episode lengths [batch] (clipped to
+  `sequence_length`) so models can mask padding.
+  """
+  tf = _tf()
+  flat = specs.flatten_spec_structure(feature_spec).to_flat_dict()
+  if SEQUENCE_LENGTH_KEY in flat:
+    raise ValueError(
+        f"Spec key {SEQUENCE_LENGTH_KEY!r} is reserved: the parser "
+        f"emits the true episode lengths under it. Rename the feature.")
+  context_map, sequence_map = build_sequence_feature_maps(feature_spec)
+  serialized = np.asarray(serialized)
+  batch_size = int(serialized.shape[0])
+  try:
+    context, parsed_seq, seq_lengths = tf.io.parse_sequence_example(
+        serialized, context_features=context_map or None,
+        sequence_features=sequence_map)
+  except Exception as e:  # surface the spec contract, not TF internals
+    raise ValueError(
+        f"tf.SequenceExample parse failed against the declared specs "
+        f"(context keys: {sorted(context_map)}, sequence keys: "
+        f"{sorted(sequence_map)}). Underlying error: {e}") from e
+
+  out: Dict[str, np.ndarray] = {}
+  true_lengths = np.zeros((batch_size,), np.int32)
+  for key, spec in flat.items():
+    name = wire_key(key, spec)
+    if not spec.is_sequence:
+      value = context[name]
+      if isinstance(value, tf.sparse.SparseTensor):
+        value = tf.sparse.to_dense(value)
+      if spec.is_image:
+        out[key] = np.stack([
+            _fit_image(decode_image_bytes(b), spec)
+            for b in value.numpy()]).astype(spec.dtype)
+      elif spec.varlen:
+        out[key] = _pad_or_truncate(np.asarray(value), spec, batch_size)
+      else:
+        out[key] = np.asarray(value).reshape(
+            (batch_size,) + tuple(spec.shape)).astype(spec.dtype)
+      continue
+
+    value = parsed_seq[name]
+    if isinstance(value, tf.RaggedTensor):
+      value = value.to_tensor()
+    if isinstance(value, tf.sparse.SparseTensor):
+      value = tf.sparse.to_dense(value)
+    lengths = np.asarray(seq_lengths[name]).reshape(batch_size)
+    true_lengths = np.maximum(true_lengths,
+                              np.minimum(lengths, sequence_length))
+    if spec.is_image:
+      frames = value.numpy()  # [B, T_max] of encoded bytes
+      decoded = np.zeros(
+          (batch_size, sequence_length) + tuple(spec.shape), spec.dtype)
+      for b in range(batch_size):
+        for t in range(min(int(lengths[b]), sequence_length)):
+          decoded[b, t] = _fit_image(decode_image_bytes(frames[b, t]),
+                                     spec)
+      out[key] = decoded
+      continue
+    dense = np.asarray(value)  # [B, T_max, prod(shape)]
+    t_max = dense.shape[1] if dense.ndim > 1 else 0
+    if t_max < sequence_length:
+      pad = [(0, 0), (0, sequence_length - t_max)] + \
+          [(0, 0)] * (dense.ndim - 2)
+      dense = np.pad(dense, pad)
+    else:
+      dense = dense[:, :sequence_length]
+    out[key] = dense.reshape(
+        (batch_size, sequence_length) + tuple(spec.shape)
+    ).astype(spec.dtype)
+
+  out[SEQUENCE_LENGTH_KEY] = true_lengths
+  return TensorSpecStruct.from_flat_dict(out)

@@ -27,6 +27,16 @@ from tensor2robot_tpu.data.abstract_input_generator import (
 from tensor2robot_tpu.specs import TensorSpecStruct
 
 
+def _merge_specs(feature_spec, label_spec=None) -> TensorSpecStruct:
+  """One flat struct over feature+label specs (one wire record holds
+  all keys; the feature/label split happens at parse time)."""
+  merged = dict(specs.flatten_spec_structure(feature_spec).to_flat_dict())
+  if label_spec is not None:
+    merged.update(
+        specs.flatten_spec_structure(label_spec).to_flat_dict())
+  return TensorSpecStruct.from_flat_dict(merged)
+
+
 @gin.configurable
 class TFRecordInputGenerator(AbstractInputGenerator):
   """Streams parsed batches from TFRecord shards."""
@@ -61,15 +71,11 @@ class TFRecordInputGenerator(AbstractInputGenerator):
           f"No TFRecord files matched patterns: {self._file_patterns}")
     return files
 
-  def _create_dataset(
-      self, mode: Mode, batch_size: int,
-  ) -> Iterator[Tuple[TensorSpecStruct, Optional[TensorSpecStruct]]]:
+  def _serialized_batches(self, mode: Mode, batch_size: int):
+    """tf.data pipeline over raw serialized records (shared plumbing)."""
     import tensorflow as tf  # lazy, host-side only
 
     files = self._file_list()
-    feature_spec = self.feature_spec
-    label_spec = self.label_spec
-
     ds = tf.data.Dataset.from_tensor_slices(files)
     if self._shuffle and mode == Mode.TRAIN:
       ds = ds.shuffle(len(files), seed=self._seed)
@@ -83,33 +89,83 @@ class TFRecordInputGenerator(AbstractInputGenerator):
       ds = ds.shuffle(self._shuffle_buffer_size, seed=self._seed)
     ds = ds.batch(batch_size, drop_remainder=True)
     ds = ds.prefetch(tf.data.AUTOTUNE)
+    return ds.as_numpy_iterator()
 
-    # One proto parse per batch over the merged feature+label map, then
-    # split back into the two structs (parsing twice doubles host cost).
-    feature_keys = set(feature_spec.to_flat_dict())
-    merged = dict(feature_spec.to_flat_dict())
-    if label_spec is not None:
-      merged.update(label_spec.to_flat_dict())
-    merged_struct = TensorSpecStruct.from_flat_dict(merged)
+  def _merged_spec(self):
+    """Feature+label specs merged for a single parse per batch.
 
-    label_keys = set(label_spec.to_flat_dict()) if label_spec is not None \
-        else set()
-    for serialized in ds.as_numpy_iterator():
+    Parsing once over the union then splitting halves the host proto
+    cost vs. parsing twice; a key declared in BOTH specs lands in both
+    output structs.
+    """
+    feature_spec = self.feature_spec
+    label_spec = self.label_spec
+    return (_merge_specs(feature_spec, label_spec),
+            set(feature_spec.to_flat_dict()),
+            set(label_spec.to_flat_dict()) if label_spec is not None
+            else None)
+
+  def _split_parsed(self, parsed, feature_keys, label_keys,
+                    extra_feature_keys=()):
+    flat = parsed.to_flat_dict()
+    features = TensorSpecStruct.from_flat_dict(
+        {k: v for k, v in flat.items()
+         if k in feature_keys or k in extra_feature_keys})
+    labels = None
+    if label_keys is not None:
+      labels = TensorSpecStruct.from_flat_dict(
+          {k: v for k, v in flat.items() if k in label_keys})
+    return features, labels
+
+  def _create_dataset(
+      self, mode: Mode, batch_size: int,
+  ) -> Iterator[Tuple[TensorSpecStruct, Optional[TensorSpecStruct]]]:
+    merged_struct, feature_keys, label_keys = self._merged_spec()
+    for serialized in self._serialized_batches(mode, batch_size):
       parsed = tfexample.parse_example_batch(serialized, merged_struct)
-      flat = parsed.to_flat_dict()
-      features = TensorSpecStruct.from_flat_dict(
-          {k: v for k, v in flat.items() if k in feature_keys})
-      labels = None
-      if label_spec is not None:
-        # Membership per spec, not set difference: a key declared in
-        # BOTH specs lands in both structs.
-        labels = TensorSpecStruct.from_flat_dict(
-            {k: v for k, v in flat.items() if k in label_keys})
-      yield features, labels
+      yield self._split_parsed(parsed, feature_keys, label_keys)
 
 
 # Reference-compatible alias.
 DefaultRecordInputGenerator = TFRecordInputGenerator
+
+
+@gin.configurable
+class TFRecordEpisodeInputGenerator(TFRecordInputGenerator):
+  """Streams episode batches from tf.SequenceExample TFRecords.
+
+  Reference parity: the reference's episode pipelines (SURVEY.md §3
+  `meta_tfdata.py`, §6 "sequences are short robot episodes") parsed
+  SequenceExamples of per-timestep features. Sequence specs
+  (`is_sequence=True`) come back as [batch, sequence_length, ...]
+  arrays — zero-padded / truncated to the fixed `sequence_length`, as
+  XLA's static shapes demand — with the TRUE pre-pad lengths under
+  `features['sequence_length']` for masking.
+  """
+
+  def __init__(self, sequence_length: int = 16,
+               include_sequence_length: bool = True, **kwargs):
+    super().__init__(**kwargs)
+    self._sequence_length = int(sequence_length)
+    self._include_sequence_length = include_sequence_length
+
+  @property
+  def sequence_length(self) -> int:
+    return self._sequence_length
+
+  def _create_dataset(
+      self, mode: Mode, batch_size: int,
+  ) -> Iterator[Tuple[TensorSpecStruct, Optional[TensorSpecStruct]]]:
+    merged_struct, feature_keys, label_keys = self._merged_spec()
+    # _split_parsed only forwards keys it is told about, so excluding
+    # the lengths is just not listing them.
+    extra = ((tfexample.SEQUENCE_LENGTH_KEY,)
+             if self._include_sequence_length else ())
+    for serialized in self._serialized_batches(mode, batch_size):
+      parsed = tfexample.parse_sequence_example_batch(
+          serialized, merged_struct, self._sequence_length)
+      yield self._split_parsed(parsed, feature_keys, label_keys,
+                               extra_feature_keys=extra)
 
 
 def write_tfrecord(
@@ -126,11 +182,26 @@ def write_tfrecord(
   """
   import tensorflow as tf  # lazy
 
-  merged_spec = specs.flatten_spec_structure(feature_spec).to_flat_dict()
-  if label_spec is not None:
-    merged_spec.update(
-        specs.flatten_spec_structure(label_spec).to_flat_dict())
-  merged_struct = TensorSpecStruct.from_flat_dict(merged_spec)
+  merged_struct = _merge_specs(feature_spec, label_spec)
   with tf.io.TFRecordWriter(path) as writer:
     for example in examples:
       writer.write(tfexample.encode_example(example, merged_struct))
+
+
+def write_episode_tfrecord(
+    path: str,
+    episodes: Sequence[dict],
+    feature_spec,
+    label_spec=None,
+) -> None:
+  """Writes episodes (flat dicts; sequence keys hold [T, ...] arrays)
+  as tf.SequenceExample records. T may vary per episode — ragged on
+  the wire; the episode generator pads to its fixed sequence_length.
+  """
+  import tensorflow as tf  # lazy
+
+  merged_struct = _merge_specs(feature_spec, label_spec)
+  with tf.io.TFRecordWriter(path) as writer:
+    for episode in episodes:
+      writer.write(
+          tfexample.encode_sequence_example(episode, merged_struct))

@@ -6,6 +6,8 @@ from tensor2robot_tpu.meta_learning.maml_model import (
     MAMLModel,
 )
 from tensor2robot_tpu.meta_learning.meta_data import (
+    EpisodeMetaInputGenerator,
     MetaExampleInputGenerator,
     make_meta_batch,
+    meta_batch_from_episodes,
 )

@@ -62,6 +62,119 @@ def make_meta_batch(features: TensorSpecStruct,
   return nest(features), nest(labels)
 
 
+def meta_batch_from_episodes(features: TensorSpecStruct,
+                             labels: Optional[TensorSpecStruct],
+                             num_condition: int,
+                             num_inference: int,
+                             context_keys: Tuple[str, ...] = (),
+                             ) -> Tuple[TensorSpecStruct,
+                                        Optional[TensorSpecStruct]]:
+  """Episode batch [B, T, ...] → meta batch; each episode is one task.
+
+  The first `num_condition` timesteps become the condition set, the
+  next `num_inference` the inference set — the reference's episode
+  semantics (demonstration prefix conditions, later steps evaluate).
+  Requires every TRUE episode length (the parser's `sequence_length`
+  feature, when present) ≥ num_condition + num_inference — zero-padded
+  timesteps must never masquerade as data. Keys in `context_keys` are
+  per-episode (no time axis); they are tiled across the per-task sample
+  dim of both splits. The `sequence_length` key itself is consumed
+  here, not forwarded.
+  """
+  need = num_condition + num_inference
+  import numpy as _np
+  flat_f = features.to_flat_dict()
+  true_lengths = flat_f.get("sequence_length")
+  if true_lengths is not None:
+    short = _np.asarray(true_lengths) < need
+    if _np.any(short):
+      raise ValueError(
+          f"{int(short.sum())} episode(s) shorter than condition+"
+          f"inference = {need} (true lengths "
+          f"{_np.asarray(true_lengths)[short].tolist()}); splitting "
+          f"them would train on zero padding.")
+
+  def nest(struct):
+    if struct is None:
+      return None
+    out = {}
+    for key, value in struct.to_flat_dict().items():
+      if key == "sequence_length":
+        continue
+      if key in context_keys:
+        cond = _np.repeat(value[:, None], num_condition, axis=1)
+        inf = _np.repeat(value[:, None], num_inference, axis=1)
+        out[f"{CONDITION}/{key}"] = cond
+        out[f"{INFERENCE}/{key}"] = inf
+        continue
+      if value.ndim < 2 or value.shape[1] < need:
+        raise ValueError(
+            f"Episode key {key!r} has shape {value.shape}; need a time "
+            f"axis of at least condition+inference = {need}. Per-episode "
+            f"(non-sequence) keys must be listed in context_keys.")
+      out[f"{CONDITION}/{key}"] = value[:, :num_condition]
+      out[f"{INFERENCE}/{key}"] = value[:, num_condition:need]
+    return TensorSpecStruct.from_flat_dict(out)
+
+  return nest(features), nest(labels)
+
+
+@gin.configurable
+class EpisodeMetaInputGenerator(AbstractInputGenerator):
+  """Turns an episode generator's [B, T, ...] batches into meta batches.
+
+  Reference parity: `meta_tfdata`'s episode→meta-example path — each
+  episode is a task; its timestep prefix conditions the inner loop.
+  `batch_size` counts TASKS (= episodes).
+  """
+
+  def __init__(self,
+               episode_generator: AbstractInputGenerator,
+               num_condition_samples_per_task: int = 4,
+               num_inference_samples_per_task: int = 4,
+               batch_size: int = 8):
+    super().__init__(batch_size=batch_size)
+    self._episodes = episode_generator
+    self._num_condition = num_condition_samples_per_task
+    self._num_inference = num_inference_samples_per_task
+
+  def set_specification_from_model(self, model, mode: Mode) -> None:
+    base_model = getattr(model, "base_model", None)
+    if base_model is None:
+      raise ValueError(
+          "EpisodeMetaInputGenerator requires a meta model exposing "
+          "`base_model` (e.g. MAMLModel).")
+    # The episode wire carries the BASE specs per timestep.
+    base_feat = base_model.get_feature_specification(mode)
+    base_label = base_model.get_label_specification(mode)
+    as_sequence = lambda s: s.replace(is_sequence=True)  # noqa: E731
+    self._episodes.set_specification(
+        TensorSpecStruct.from_flat_dict(
+            {k: as_sequence(v)
+             for k, v in base_feat.to_flat_dict().items()}),
+        TensorSpecStruct.from_flat_dict(
+            {k: as_sequence(v)
+             for k, v in base_label.to_flat_dict().items()})
+        if base_label is not None else None)
+    self.set_specification(
+        model.preprocessor.get_in_feature_specification(mode),
+        model.preprocessor.get_in_label_specification(mode))
+
+  def _create_dataset(self, mode: Mode, batch_size: int
+                      ) -> Iterator[Tuple[TensorSpecStruct,
+                                          Optional[TensorSpecStruct]]]:
+    # Per-episode (non-sequence) keys carry no time axis and must be
+    # tiled, not sliced.
+    context_keys = tuple(
+        k for k, s in self._episodes.feature_spec.to_flat_dict().items()
+        if not s.is_sequence)
+    for features, labels in self._episodes.create_dataset(
+        mode, batch_size=batch_size):
+      yield meta_batch_from_episodes(
+          features, labels, self._num_condition, self._num_inference,
+          context_keys=context_keys)
+
+
 @gin.configurable
 class MetaExampleInputGenerator(AbstractInputGenerator):
   """Wraps a flat generator into meta-example batches.

@@ -131,6 +131,165 @@ class TestTFExampleCodec:
                                feature_spec())
 
 
+def episode_spec():
+  st = TensorSpecStruct()
+  st.image = ExtendedTensorSpec(shape=(8, 8, 3), dtype=np.uint8,
+                                name="frame", data_format="png",
+                                is_sequence=True)
+  st.state = ExtendedTensorSpec(shape=(3,), dtype=np.float32,
+                                name="state", is_sequence=True)
+  st.task_id = ExtendedTensorSpec(shape=(1,), dtype=np.int64,
+                                  name="task_id")
+  return st
+
+
+def episode_label_spec():
+  st = TensorSpecStruct()
+  st.action = ExtendedTensorSpec(shape=(2,), dtype=np.float32,
+                                 name="action", is_sequence=True)
+  return st
+
+
+def make_episode(rng, t):
+  return {
+      "image": rng.integers(0, 255, (t, 8, 8, 3), dtype=np.uint8),
+      "state": rng.standard_normal((t, 3)).astype(np.float32),
+      "task_id": np.array([7], np.int64),
+      "action": rng.standard_normal((t, 2)).astype(np.float32),
+  }
+
+
+class TestSequenceExampleCodec:
+
+  def test_roundtrip_pads_and_reports_lengths(self):
+    fs = episode_spec()
+    rng = np.random.default_rng(0)
+    ep_short = make_episode(rng, 3)
+    ep_long = make_episode(rng, 6)
+    serialized = np.array([
+        tfexample.encode_sequence_example(ep_short, fs),
+        tfexample.encode_sequence_example(ep_long, fs),
+    ])
+    batch = tfexample.parse_sequence_example_batch(
+        serialized, fs, sequence_length=4)
+    # Static [B, T, ...] shapes with zero padding / truncation.
+    assert batch["image"].shape == (2, 4, 8, 8, 3)
+    assert batch["state"].shape == (2, 4, 3)
+    assert batch["task_id"].shape == (2, 1)
+    np.testing.assert_array_equal(
+        batch[tfexample.SEQUENCE_LENGTH_KEY], [3, 4])
+    # png is lossless: frames round-trip exactly; padding is zeros.
+    np.testing.assert_array_equal(batch["image"][0, :3],
+                                  ep_short["image"])
+    np.testing.assert_array_equal(batch["image"][0, 3],
+                                  np.zeros((8, 8, 3), np.uint8))
+    np.testing.assert_allclose(batch["state"][1], ep_long["state"][:4],
+                               rtol=1e-6)
+    np.testing.assert_array_equal(batch["task_id"][1], [7])
+
+  def test_mismatched_sequence_lengths_rejected(self):
+    fs = episode_spec()
+    rng = np.random.default_rng(1)
+    ep = make_episode(rng, 3)
+    ep["state"] = ep["state"][:2]
+    with pytest.raises(ValueError, match="share a length"):
+      tfexample.encode_sequence_example(ep, fs)
+
+  def test_missing_required_sequence_feature_raises(self):
+    fs = episode_spec()
+    with pytest.raises(ValueError, match="state"):
+      tfexample.encode_sequence_example(
+          {"image": np.zeros((2, 8, 8, 3), np.uint8),
+           "task_id": np.array([0], np.int64)}, fs)
+
+
+class TestEpisodeGenerator:
+
+  def test_end_to_end(self, tmp_path):
+    from tensor2robot_tpu.data import (
+        TFRecordEpisodeInputGenerator,
+        write_episode_tfrecord,
+    )
+    fs, ls = episode_spec(), episode_label_spec()
+    rng = np.random.default_rng(0)
+    episodes = [make_episode(rng, t) for t in [3, 5, 4, 6]]
+    path = str(tmp_path / "episodes.tfrecord")
+    write_episode_tfrecord(path, episodes, fs, ls)
+
+    gen = TFRecordEpisodeInputGenerator(
+        file_patterns=path, batch_size=2, sequence_length=5,
+        shuffle=False)
+    gen.set_specification(fs, ls)
+    features, labels = next(gen.create_dataset(Mode.TRAIN))
+    assert features["image"].shape == (2, 5, 8, 8, 3)
+    assert features["state"].shape == (2, 5, 3)
+    assert features["task_id"].shape == (2, 1)
+    np.testing.assert_array_equal(features["sequence_length"], [3, 5])
+    assert labels["action"].shape == (2, 5, 2)
+
+  def test_meta_batch_from_episodes(self):
+    from tensor2robot_tpu.meta_learning import meta_batch_from_episodes
+    rng = np.random.default_rng(0)
+    features = TensorSpecStruct.from_flat_dict({
+        "state": rng.standard_normal((2, 6, 3)).astype(np.float32),
+        "sequence_length": np.array([6, 6], np.int32),
+    })
+    labels = TensorSpecStruct.from_flat_dict({
+        "action": rng.standard_normal((2, 6, 2)).astype(np.float32)})
+    mf, ml = meta_batch_from_episodes(features, labels,
+                                      num_condition=4, num_inference=2)
+    assert mf["condition/state"].shape == (2, 4, 3)
+    assert mf["inference/state"].shape == (2, 2, 3)
+    assert "sequence_length" not in mf
+    assert ml["condition/action"].shape == (2, 4, 2)
+    np.testing.assert_array_equal(
+        mf["inference/state"],
+        np.asarray(features["state"])[:, 4:6])
+
+  def test_too_short_episode_raises(self):
+    from tensor2robot_tpu.meta_learning import meta_batch_from_episodes
+    features = TensorSpecStruct.from_flat_dict({
+        "state": np.zeros((2, 3, 3), np.float32)})
+    with pytest.raises(ValueError, match="time"):
+      meta_batch_from_episodes(features, None, num_condition=4,
+                               num_inference=2)
+
+  def test_padded_short_episode_rejected_via_true_lengths(self):
+    # A zero-padded [B, 16, ...] batch LOOKS long enough; the true
+    # lengths say otherwise and must win.
+    from tensor2robot_tpu.meta_learning import meta_batch_from_episodes
+    features = TensorSpecStruct.from_flat_dict({
+        "state": np.zeros((2, 16, 3), np.float32),
+        "sequence_length": np.array([3, 16], np.int32)})
+    with pytest.raises(ValueError, match="zero padding"):
+      meta_batch_from_episodes(features, None, num_condition=4,
+                               num_inference=4)
+
+  def test_context_keys_tiled_not_sliced(self):
+    from tensor2robot_tpu.meta_learning import meta_batch_from_episodes
+    goal = np.arange(20, dtype=np.float32).reshape(2, 10)
+    features = TensorSpecStruct.from_flat_dict({
+        "state": np.zeros((2, 8, 3), np.float32),
+        "goal": goal})
+    mf, _ = meta_batch_from_episodes(features, None, num_condition=4,
+                                     num_inference=2,
+                                     context_keys=("goal",))
+    assert mf["condition/goal"].shape == (2, 4, 10)
+    assert mf["inference/goal"].shape == (2, 2, 10)
+    np.testing.assert_array_equal(mf["condition/goal"][:, 0], goal)
+    np.testing.assert_array_equal(mf["condition/goal"][:, 3], goal)
+
+  def test_reserved_sequence_length_spec_key_rejected(self):
+    st = TensorSpecStruct()
+    st.x = ExtendedTensorSpec(shape=(2,), dtype=np.float32, name="x",
+                              is_sequence=True)
+    st.sequence_length = ExtendedTensorSpec(shape=(1,), dtype=np.int64,
+                                            name="seq_len")
+    with pytest.raises(ValueError, match="reserved"):
+      tfexample.parse_sequence_example_batch(
+          np.array([b""]), st, sequence_length=2)
+
+
 class TestTFRecordGenerator:
 
   def test_end_to_end(self, tmp_path):
