@@ -40,7 +40,10 @@ _DEFAULT_MODULES = (
     "tensor2robot_tpu.export",
     "tensor2robot_tpu.predictors",
     "tensor2robot_tpu.hooks",
+    "tensor2robot_tpu.meta_learning",
     "tensor2robot_tpu.research.pose_env",
+    "tensor2robot_tpu.research.qtopt",
+    "tensor2robot_tpu.research.vrgripper",
 )
 
 

@@ -1,0 +1,30 @@
+"""VRGripper / Watch-Try-Learn research family.
+
+Reference parity: tensor2robot `research/vrgripper/` — behavioral
+cloning from demonstrations (plain + MDN policies), episode→transition
+munging, meta-BC (MAML / SNAIL), and Watch-Try-Learn trial-conditioned
+policies (SURVEY.md §3 "VRGripper / WTL").
+"""
+
+from tensor2robot_tpu.research.vrgripper.episode_to_transitions import (
+    TransitionInputGenerator,
+    episode_batch_to_transitions,
+)
+from tensor2robot_tpu.research.vrgripper.vrgripper_env import (
+    VRGripperEnv,
+    collect_demo_episodes,
+    collect_expert_episode,
+    evaluate_gripper_policy,
+    sample_wtl_meta_batch,
+)
+from tensor2robot_tpu.research.vrgripper.vrgripper_models import (
+    GripperObsEncoder,
+    VRGripperRegressionModel,
+)
+from tensor2robot_tpu.research.vrgripper.vrgripper_meta_models import (
+    VRGripperMAMLModel,
+    VRGripperSNAILModel,
+)
+from tensor2robot_tpu.research.vrgripper.vrgripper_wtl_models import (
+    VRGripperWTLModel,
+)

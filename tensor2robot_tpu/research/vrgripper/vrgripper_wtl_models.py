@@ -1,0 +1,302 @@
+"""Watch-Try-Learn: trial-conditioned gripper policies.
+
+Reference parity: tensor2robot `research/vrgripper/
+vrgripper_env_wtl_models.py` — Watch-Try-Learn (Zhou et al. 2019,
+arXiv:1906.03352): a TRIAL policy conditioned on a watched
+demonstration proposes an attempt; a RETRIAL policy conditioned on the
+demonstration AND the executed trial (with its rewards) improves on it
+(SURVEY.md §3 "VRGripper / WTL"; file:line unavailable — empty
+reference mount).
+
+TPU-first: episode embeddings are mean-pooled per-step encodings with
+the step dim folded into the batch dim (one conv batch for all tasks ×
+steps — MXU-sized), conditioning is plain concatenation, everything
+static-shaped. Both policies are one class: `policy_type='trial'`
+drops the trial split from the specs and the network.
+
+Meta-batch layout (B tasks):
+  features.condition/…   demo observations     [B, N_demo, …]
+  features.trial/…       trial obs + action + reward  [B, N_trial, …]
+                         (retrial policy only; actions/rewards are
+                         features — the robot executed and observed them)
+  features.inference/…   query observations    [B, N_query, …]
+  labels.condition/action  demo actions [B, N_demo, A]
+  labels.inference/action  target actions [B, N_query, A]
+At predict time demo actions ride in features under
+condition_labels/action (optional ⇒ absent = unconditioned), the same
+serving convention as the MAML/SNAIL models.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu import config as gin
+from tensor2robot_tpu.data.abstract_input_generator import Mode
+from tensor2robot_tpu.layers import MLP
+from tensor2robot_tpu.layers.mdn import MDNHead, mdn_loss, mdn_mode
+from tensor2robot_tpu.meta_learning.maml_model import (
+    CONDITION,
+    CONDITION_LABELS,
+    INFERENCE,
+)
+from tensor2robot_tpu.models.abstract_model import AbstractT2RModel
+from tensor2robot_tpu.models.regression_model import INFERENCE_OUTPUT
+from tensor2robot_tpu.research.vrgripper.vrgripper_models import (
+    ACTION,
+    GripperObsEncoder,
+    mdn_params_from_outputs,
+)
+from tensor2robot_tpu.specs import ExtendedTensorSpec, TensorSpecStruct
+
+TRIAL = "trial"
+REWARD = "reward"
+
+TRIAL_POLICY = "trial"
+RETRIAL_POLICY = "retrial"
+
+
+class _WTLPolicyNet(nn.Module):
+  """Demo (+ trial) episode embeddings conditioning a query policy."""
+
+  action_dim: int
+  num_condition: int
+  num_trial: int  # 0 for the trial policy (no trial conditioning)
+  num_inference: int
+  filters: Sequence[int]
+  embedding_size: int
+  hidden_sizes: Sequence[int]
+  num_mixture_components: int
+  dtype: Any = jnp.bfloat16
+
+  @nn.compact
+  def __call__(self, features, train: bool = False):
+    num_tasks = jax.tree_util.tree_leaves(
+        features[CONDITION])[0].shape[0]
+    encoder = GripperObsEncoder(
+        filters=tuple(self.filters),
+        embedding_size=self.embedding_size,
+        use_batch_norm=False, dtype=self.dtype, name="obs_encoder")
+
+    def encode(split, n):
+      folded = jax.tree_util.tree_map(
+          lambda x: x.reshape((num_tasks * n,) + x.shape[2:]), split)
+      return encoder(folded, train=train).reshape(num_tasks, n, -1)
+
+    flat = features.to_flat_dict()
+
+    # Demonstration embedding: per-step [obs_emb ‖ action] → MLP →
+    # mean over steps (order-invariant, static-shaped).
+    cond_emb = encode(features[CONDITION], self.num_condition)
+    demo_key = f"{CONDITION_LABELS}/{ACTION}"
+    if demo_key in flat:
+      demo_actions = flat[demo_key].astype(self.dtype)
+    else:
+      demo_actions = jnp.zeros(
+          (num_tasks, self.num_condition, self.action_dim), self.dtype)
+    demo_step = jnp.concatenate(
+        [cond_emb.astype(self.dtype), demo_actions], axis=-1)
+    demo_embed = MLP(hidden_sizes=(self.embedding_size,),
+                     output_size=self.embedding_size, dtype=self.dtype,
+                     name="demo_embed")(
+        demo_step.reshape(num_tasks * self.num_condition, -1),
+        train=train).reshape(num_tasks, self.num_condition, -1)
+    demo_embed = jnp.mean(demo_embed, axis=1)  # [B, E]
+
+    context = [demo_embed.astype(self.dtype)]
+
+    if self.num_trial > 0:
+      trial = features[TRIAL]
+      trial_obs = TensorSpecStruct.from_flat_dict(
+          {k: v for k, v in trial.to_flat_dict().items()
+           if k not in (ACTION, REWARD)})
+      trial_emb = encode(trial_obs, self.num_trial)
+      trial_step = jnp.concatenate([
+          trial_emb.astype(self.dtype),
+          trial[ACTION].astype(self.dtype),
+          trial[REWARD].astype(self.dtype),
+      ], axis=-1)
+      trial_embed = MLP(hidden_sizes=(self.embedding_size,),
+                        output_size=self.embedding_size,
+                        dtype=self.dtype, name="trial_embed")(
+          trial_step.reshape(num_tasks * self.num_trial, -1),
+          train=train).reshape(num_tasks, self.num_trial, -1)
+      context.append(jnp.mean(trial_embed, axis=1).astype(self.dtype))
+
+    # Query policy: [query_emb ‖ context…] → trunk → action head.
+    inf_emb = encode(features[INFERENCE], self.num_inference)
+    ctx = jnp.concatenate(context, axis=-1)[:, None, :]
+    ctx = jnp.broadcast_to(
+        ctx, (num_tasks, self.num_inference, ctx.shape[-1]))
+    query = jnp.concatenate([inf_emb.astype(self.dtype), ctx], axis=-1)
+    trunk = MLP(hidden_sizes=tuple(self.hidden_sizes),
+                output_size=None, activate_final=True,
+                dtype=self.dtype, name="trunk")(
+        query.reshape(num_tasks * self.num_inference, -1), train=train)
+
+    if self.num_mixture_components > 0:
+      params = MDNHead(num_components=self.num_mixture_components,
+                       output_size=self.action_dim, dtype=self.dtype,
+                       name="mdn_head")(trunk)
+      reshape = lambda a: a.reshape(  # noqa: E731
+          (num_tasks, self.num_inference) + a.shape[1:])
+      action = reshape(mdn_mode(params))
+      return {ACTION: action, INFERENCE_OUTPUT: action,
+              "mdn_logits": reshape(params.logits),
+              "mdn_means": reshape(params.means),
+              "mdn_log_scales": reshape(params.log_scales)}
+    action = nn.Dense(self.action_dim, dtype=self.dtype,
+                      name="action_head")(trunk)
+    action = action.astype(jnp.float32).reshape(
+        num_tasks, self.num_inference, self.action_dim)
+    return {ACTION: action, INFERENCE_OUTPUT: action}
+
+
+@gin.configurable
+class VRGripperWTLModel(AbstractT2RModel):
+  """Watch-Try-Learn policy (`policy_type`: 'trial' or 'retrial')."""
+
+  def __init__(self,
+               policy_type: str = RETRIAL_POLICY,
+               image_size: int = 48,
+               state_dim: int = 3,
+               action_dim: int = 3,
+               filters: Sequence[int] = (16, 32),
+               embedding_size: int = 64,
+               hidden_sizes: Sequence[int] = (64,),
+               num_mixture_components: int = 0,
+               num_condition_samples_per_task: int = 4,
+               num_trial_samples_per_task: int = 4,
+               num_inference_samples_per_task: int = 4,
+               device_dtype=jnp.bfloat16,
+               **kwargs):
+    if policy_type not in (TRIAL_POLICY, RETRIAL_POLICY):
+      raise ValueError(f"Unknown policy_type: {policy_type!r}")
+    super().__init__(device_dtype=device_dtype, **kwargs)
+    self._policy_type = policy_type
+    self._image_size = image_size
+    self._state_dim = state_dim
+    self._action_dim = action_dim
+    self._filters = tuple(filters)
+    self._embedding_size = embedding_size
+    self._hidden_sizes = tuple(hidden_sizes)
+    self._num_mixture_components = num_mixture_components
+    self._num_condition = num_condition_samples_per_task
+    self._num_trial = (num_trial_samples_per_task
+                       if policy_type == RETRIAL_POLICY else 0)
+    self._num_inference = num_inference_samples_per_task
+
+  @property
+  def policy_type(self) -> str:
+    return self._policy_type
+
+  def _obs_specs(self, n: int, prefix: str) -> Dict[str, Any]:
+    return {
+        "image": ExtendedTensorSpec(
+            shape=(n, self._image_size, self._image_size, 3),
+            dtype=np.uint8, name=f"{prefix}_image"),
+        "gripper_pose": ExtendedTensorSpec(
+            shape=(n, self._state_dim), dtype=np.float32,
+            name=f"{prefix}_gripper_pose"),
+    }
+
+  def get_feature_specification(self, mode: Mode) -> TensorSpecStruct:
+    flat = {}
+    for key, spec in self._obs_specs(self._num_condition,
+                                     CONDITION).items():
+      flat[f"{CONDITION}/{key}"] = spec
+    if self._num_trial > 0:
+      for key, spec in self._obs_specs(self._num_trial, TRIAL).items():
+        flat[f"{TRIAL}/{key}"] = spec
+      flat[f"{TRIAL}/{ACTION}"] = ExtendedTensorSpec(
+          shape=(self._num_trial, self._action_dim), dtype=np.float32,
+          name="trial_action")
+      flat[f"{TRIAL}/{REWARD}"] = ExtendedTensorSpec(
+          shape=(self._num_trial, 1), dtype=np.float32,
+          name="trial_reward")
+    for key, spec in self._obs_specs(self._num_inference,
+                                     INFERENCE).items():
+      flat[f"{INFERENCE}/{key}"] = spec
+    if mode == Mode.PREDICT:
+      # Demo actions for serving-time conditioning (absent ⇒ zeros).
+      flat[f"{CONDITION_LABELS}/{ACTION}"] = ExtendedTensorSpec(
+          shape=(self._num_condition, self._action_dim),
+          dtype=np.float32, name="condition_action", is_optional=True)
+    return TensorSpecStruct.from_flat_dict(flat)
+
+  def get_label_specification(self, mode: Mode) -> TensorSpecStruct:
+    flat = {
+        f"{CONDITION}/{ACTION}": ExtendedTensorSpec(
+            shape=(self._num_condition, self._action_dim),
+            dtype=np.float32, name="demo_action"),
+        f"{INFERENCE}/{ACTION}": ExtendedTensorSpec(
+            shape=(self._num_inference, self._action_dim),
+            dtype=np.float32, name="target_action"),
+    }
+    return TensorSpecStruct.from_flat_dict(flat)
+
+  def create_network(self) -> nn.Module:
+    return _WTLPolicyNet(
+        action_dim=self._action_dim,
+        num_condition=self._num_condition,
+        num_trial=self._num_trial,
+        num_inference=self._num_inference,
+        filters=self._filters,
+        embedding_size=self._embedding_size,
+        hidden_sizes=self._hidden_sizes,
+        num_mixture_components=self._num_mixture_components,
+        dtype=self.device_dtype,
+    )
+
+  def loss_fn(self, params, batch_stats, features, labels, rng,
+              mode: Mode):
+    if batch_stats:
+      raise ValueError("WTL policies must be batch-stats free.")
+    train = mode == Mode.TRAIN
+    rng_pre, rng_net = (jax.random.split(rng) if rng is not None
+                        else (None, None))
+    features, labels = self.preprocessor.preprocess(
+        features, labels, mode, rng_pre)
+    # Demo actions are conditioning INPUT: lift them from labels into
+    # the feature struct (predict-time they arrive via
+    # condition_labels directly).
+    flat = features.to_flat_dict()
+    if labels is not None:
+      flat[f"{CONDITION_LABELS}/{ACTION}"] = labels[CONDITION][ACTION]
+    features = TensorSpecStruct.from_flat_dict(flat)
+    rngs = {"dropout": rng_net} if (train and rng_net is not None) \
+        else None
+    outputs = self.network.apply({"params": params}, features,
+                                 train=train, rngs=rngs)
+    target = labels[INFERENCE][ACTION].astype(jnp.float32)
+    predicted = outputs[ACTION].astype(jnp.float32)
+    action_error = jnp.mean(jnp.abs(predicted - target))
+    mdn_params = mdn_params_from_outputs(outputs)
+    if mdn_params is not None:
+      loss = mdn_loss(mdn_params, target)
+      metrics = {"nll": loss, "action_error": action_error}
+    else:
+      loss = jnp.mean(jnp.square(predicted - target))
+      metrics = {"mse": loss, "action_error": action_error}
+    return loss, (metrics, batch_stats)
+
+  def model_train_fn(self, features, labels, outputs, mode):
+    raise NotImplementedError(
+        "VRGripperWTLModel computes its loss in loss_fn.")
+
+  def eval_step(self, state, features, labels) -> Dict[str, jax.Array]:
+    loss, (metrics, _) = self.loss_fn(
+        state.params, state.batch_stats, features, labels, None,
+        Mode.EVAL)
+    return {"loss": loss, **metrics}
+
+  def predict_step(self, state, features) -> Any:
+    features, _ = self.preprocessor.preprocess(
+        features, None, Mode.PREDICT, None)
+    return self.network.apply({"params": state.params}, features,
+                              train=False)

@@ -1,0 +1,422 @@
+"""Tests for the VRGripper / Watch-Try-Learn research family.
+
+Mirrors test_qtopt.py's depth: env sanity, model train steps,
+episode→transition munging, meta-BC (MAML + SNAIL), WTL, and an
+end-to-end collect→train→predict→closed-loop-eval run.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.data import (
+    Mode,
+    RandomInputGenerator,
+    TFRecordEpisodeInputGenerator,
+)
+from tensor2robot_tpu.meta_learning import EpisodeMetaInputGenerator
+from tensor2robot_tpu.research.vrgripper import (
+    TransitionInputGenerator,
+    VRGripperEnv,
+    VRGripperMAMLModel,
+    VRGripperRegressionModel,
+    VRGripperSNAILModel,
+    VRGripperWTLModel,
+    collect_demo_episodes,
+    collect_expert_episode,
+    episode_batch_to_transitions,
+    evaluate_gripper_policy,
+    sample_wtl_meta_batch,
+)
+from tensor2robot_tpu.specs import TensorSpecStruct, make_random_tensors
+
+IMG = 24  # small images keep CPU-mesh tests fast
+
+
+def fast_adam(lr=3e-3):
+  import functools
+  from tensor2robot_tpu.models import create_optimizer
+  return functools.partial(create_optimizer, learning_rate=lr)
+
+
+def tiny_bc_model(**kwargs):
+  kwargs.setdefault("image_size", IMG)
+  kwargs.setdefault("filters", (8, 16))
+  kwargs.setdefault("embedding_size", 32)
+  kwargs.setdefault("hidden_sizes", (32,))
+  kwargs.setdefault("create_optimizer_fn", fast_adam())
+  return VRGripperRegressionModel(**kwargs)
+
+
+def random_batch(model, batch=4, seed=0):
+  f = make_random_tensors(model.get_feature_specification(Mode.TRAIN),
+                          batch_size=batch, seed=seed)
+  l = make_random_tensors(model.get_label_specification(Mode.TRAIN),
+                          batch_size=batch, seed=seed + 1)
+  dev = lambda t: jax.tree_util.tree_map(jnp.asarray, t)
+  return dev(f), dev(l)
+
+
+class TestVRGripperEnv:
+
+  def test_expert_succeeds(self):
+    env = VRGripperEnv(image_size=IMG, seed=0)
+    successes = []
+    for _ in range(10):
+      obs = env.reset()
+      done = False
+      while not done:
+        obs, _, done = env.step(env.expert_action())
+      successes.append(env.success())
+    assert np.mean(successes) > 0.9
+
+  def test_episode_structure(self):
+    env = VRGripperEnv(image_size=IMG, seed=1)
+    ep = collect_expert_episode(env)
+    t = len(ep["action"])
+    assert 1 <= t <= env.max_steps
+    assert ep["image"].shape == (t, IMG, IMG, 3)
+    assert ep["gripper_pose"].shape == (t, 3)
+    assert ep["reward"].shape == (t, 1)
+    # Terminal reward reflects the expert's success.
+    assert ep["reward"][-1, 0] == 1.0
+
+  def test_offset_changes_expert_target(self):
+    env = VRGripperEnv(image_size=IMG, seed=2)
+    env.reset(task_offset=np.array([0.2, 0.0], np.float32))
+    target_with = env.target.copy()
+    env._offset = np.zeros(2, np.float32)
+    assert np.linalg.norm(target_with - env.target) > 0.1
+
+
+class TestVRGripperBCModels:
+
+  def test_mse_train_step(self):
+    model = tiny_bc_model()
+    state = model.create_train_state(jax.random.PRNGKey(0))
+    f, l = random_batch(model)
+    state, metrics = jax.jit(model.train_step)(
+        state, f, l, jax.random.PRNGKey(0))
+    assert np.isfinite(float(metrics["loss"]))
+    assert "mse" in metrics
+
+  def test_mdn_train_step_and_sampling(self):
+    model = tiny_bc_model(num_mixture_components=3)
+    state = model.create_train_state(jax.random.PRNGKey(0))
+    f, l = random_batch(model)
+    state, metrics = jax.jit(model.train_step)(
+        state, f, l, jax.random.PRNGKey(0))
+    assert "nll" in metrics and np.isfinite(float(metrics["loss"]))
+    outputs = model.predict_step(state, f)
+    assert outputs["action"].shape == (4, 3)
+    sampled = model.sample_action(state, f, jax.random.PRNGKey(1))
+    assert sampled.shape == (4, 3)
+    # Stochastic samples differ from the greedy mode action.
+    assert not np.allclose(np.asarray(sampled),
+                           np.asarray(outputs["action"]))
+
+  def test_bc_learns_expert(self):
+    # Clone the scripted expert from its own demos; the policy must
+    # beat the do-nothing baseline by a wide margin on action error.
+    model = tiny_bc_model()
+    state = model.create_train_state(jax.random.PRNGKey(0))
+    env = VRGripperEnv(image_size=IMG, seed=0)
+    rng = np.random.default_rng(0)
+    eps = [collect_expert_episode(env, rng=rng) for _ in range(24)]
+    obs = np.concatenate([e["image"] for e in eps])
+    poses = np.concatenate([e["gripper_pose"] for e in eps])
+    acts = np.concatenate([e["action"] for e in eps])
+    step = jax.jit(model.train_step)
+    n = len(acts)
+    losses = []
+    for i in range(250):
+      idx = rng.choice(n, 32)
+      f = TensorSpecStruct.from_flat_dict(
+          {"image": jnp.asarray(obs[idx]),
+           "gripper_pose": jnp.asarray(poses[idx])})
+      l = TensorSpecStruct.from_flat_dict(
+          {"action": jnp.asarray(acts[idx])})
+      state, metrics = step(state, f, l, jax.random.PRNGKey(i))
+      losses.append(float(metrics["loss"]))
+    # Predicting the dataset-mean action scores ≈ E[a²] ≈ 0.69 here;
+    # a working clone must land far below it.
+    assert np.mean(losses[-10:]) < 0.25, losses[-10:]
+
+
+class TestEpisodeToTransitions:
+
+  def test_masks_padding(self):
+    features = TensorSpecStruct.from_flat_dict({
+        "x": np.arange(24, dtype=np.float32).reshape(2, 6, 2),
+        "sequence_length": np.array([3, 5], np.int32)})
+    labels = TensorSpecStruct.from_flat_dict({
+        "a": np.ones((2, 6, 1), np.float32)})
+    f, l = episode_batch_to_transitions(features, labels)
+    assert f["x"].shape == (8, 2)  # 3 + 5 real steps
+    assert l["a"].shape == (8, 1)
+    np.testing.assert_array_equal(f["x"][:3],
+                                  np.arange(6).reshape(3, 2))
+
+  def test_context_repeated(self):
+    features = TensorSpecStruct.from_flat_dict({
+        "x": np.zeros((2, 3, 2), np.float32),
+        "task": np.array([[1.0], [2.0]], np.float32)})
+    f, _ = episode_batch_to_transitions(features, None)
+    np.testing.assert_array_equal(f["task"].reshape(-1),
+                                  [1, 1, 1, 2, 2, 2])
+
+  def test_generator_rebatches(self, tmp_path):
+    path = str(tmp_path / "demos.tfrecord")
+    collect_demo_episodes(path, num_episodes=12, image_size=IMG,
+                          seed=0)
+    model = tiny_bc_model()
+    gen = TransitionInputGenerator(
+        TFRecordEpisodeInputGenerator(
+            file_patterns=path, sequence_length=12, shuffle=False),
+        batch_size=16, seed=0)
+    gen.set_specification_from_model(model, Mode.TRAIN)
+    it = gen.create_dataset(Mode.TRAIN)
+    for _ in range(3):
+      f, l = next(it)
+      assert f["image"].shape == (16, IMG, IMG, 3)
+      assert f["gripper_pose"].shape == (16, 3)
+      assert l["action"].shape == (16, 3)
+
+
+class TestMetaBCModels:
+
+  def _meta_batch(self, model, batch=2, seed=0):
+    f = make_random_tensors(
+        model.preprocessor.get_in_feature_specification(Mode.TRAIN),
+        batch_size=batch, seed=seed)
+    l = make_random_tensors(
+        model.preprocessor.get_in_label_specification(Mode.TRAIN),
+        batch_size=batch, seed=seed + 1)
+    dev = lambda t: jax.tree_util.tree_map(jnp.asarray, t)
+    return dev(f), dev(l)
+
+  def test_maml_train_step(self):
+    model = VRGripperMAMLModel(
+        image_size=IMG, filters=(8,), embedding_size=16,
+        hidden_sizes=(16,), num_condition_samples_per_task=2,
+        num_inference_samples_per_task=2)
+    state = model.create_train_state(jax.random.PRNGKey(0))
+    f, l = self._meta_batch(model)
+    state, metrics = jax.jit(model.train_step)(
+        state, f, l, jax.random.PRNGKey(0))
+    assert np.isfinite(float(metrics["loss"]))
+    assert "post_adaptation_loss" in metrics
+
+  def test_snail_train_step_and_predict(self):
+    model = VRGripperSNAILModel(
+        image_size=IMG, filters=(8,), embedding_size=16,
+        snail_filters=8, num_condition_samples_per_task=3,
+        num_inference_samples_per_task=2)
+    state = model.create_train_state(jax.random.PRNGKey(0))
+    f, l = self._meta_batch(model)
+    state, metrics = jax.jit(model.train_step)(
+        state, f, l, jax.random.PRNGKey(0))
+    assert np.isfinite(float(metrics["loss"]))
+    # Predict with demonstration actions in features.
+    pf = make_random_tensors(
+        model.preprocessor.get_in_feature_specification(Mode.PREDICT),
+        batch_size=2, seed=3)
+    outputs = jax.jit(model.predict_step)(
+        state, jax.tree_util.tree_map(jnp.asarray, pf))
+    assert outputs["action"].shape == (2, 2, 3)
+
+  def test_snail_uses_demonstrations(self):
+    # In-context learning sanity: the task is "output the constant
+    # action revealed by the demos". A correct SNAIL conditions on the
+    # demo actions; after training, predictions must track the demoed
+    # action, not the average.
+    model = VRGripperSNAILModel(
+        image_size=IMG, filters=(8,), embedding_size=16,
+        snail_filters=16, num_condition_samples_per_task=3,
+        num_inference_samples_per_task=2,
+        create_optimizer_fn=fast_adam())
+    state = model.create_train_state(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    step = jax.jit(model.train_step)
+
+    def make_batch(seed):
+      r = np.random.default_rng(seed)
+      tasks = 8
+      task_action = r.uniform(-1, 1, (tasks, 1, 3)).astype(np.float32)
+      f = {}
+      for split, n in (("condition", 3), ("inference", 2)):
+        f[f"{split}/image"] = r.integers(
+            0, 255, (tasks, n, IMG, IMG, 3)).astype(np.uint8)
+        f[f"{split}/gripper_pose"] = r.standard_normal(
+            (tasks, n, 3)).astype(np.float32)
+      l = {"condition/action": np.tile(task_action, (1, 3, 1)),
+           "inference/action": np.tile(task_action, (1, 2, 1))}
+      dev = lambda d: jax.tree_util.tree_map(
+          jnp.asarray, TensorSpecStruct.from_flat_dict(d))
+      return dev(f), dev(l)
+
+    losses = []
+    for i in range(150):
+      f, l = make_batch(i)
+      state, metrics = step(state, f, l, jax.random.PRNGKey(i))
+      losses.append(float(metrics["loss"]))
+    # Predicting the mean action (0) gives mse ≈ E[a²] = 1/3; using
+    # the demos must do far better.
+    assert np.mean(losses[-10:]) < 0.1, losses[-10:]
+
+
+class TestWTLModels:
+
+  def test_trial_policy_shapes(self):
+    model = VRGripperWTLModel(
+        policy_type="trial", image_size=IMG, filters=(8,),
+        embedding_size=16, hidden_sizes=(16,),
+        num_condition_samples_per_task=2,
+        num_inference_samples_per_task=2)
+    feat = model.get_feature_specification(Mode.TRAIN)
+    assert "trial" not in feat
+    state = model.create_train_state(jax.random.PRNGKey(0))
+    f, l = random_batch(model, batch=2)
+    state, metrics = jax.jit(model.train_step)(
+        state, f, l, jax.random.PRNGKey(0))
+    assert np.isfinite(float(metrics["loss"]))
+
+  def test_retrial_policy_consumes_trial(self):
+    model = VRGripperWTLModel(
+        policy_type="retrial", image_size=IMG, filters=(8,),
+        embedding_size=16, hidden_sizes=(16,),
+        num_condition_samples_per_task=2,
+        num_trial_samples_per_task=2,
+        num_inference_samples_per_task=2)
+    feat = model.get_feature_specification(Mode.TRAIN)
+    assert feat["trial/action"].shape == (2, 3)
+    assert feat["trial/reward"].shape == (2, 1)
+    state = model.create_train_state(jax.random.PRNGKey(0))
+    f, l = random_batch(model, batch=2)
+    state, metrics = jax.jit(model.train_step)(
+        state, f, l, jax.random.PRNGKey(0))
+    assert np.isfinite(float(metrics["loss"]))
+
+  def test_wtl_learns_on_scripted_tasks(self):
+    model = VRGripperWTLModel(
+        policy_type="retrial", image_size=IMG, filters=(8,),
+        embedding_size=32, hidden_sizes=(32,),
+        num_condition_samples_per_task=4,
+        num_trial_samples_per_task=4,
+        num_inference_samples_per_task=4,
+        create_optimizer_fn=fast_adam())
+    state = model.create_train_state(jax.random.PRNGKey(0))
+    step = jax.jit(model.train_step)
+    batches = []
+    for s in range(8):
+      f, l = sample_wtl_meta_batch(num_tasks=4, image_size=IMG, seed=s)
+      batches.append((
+          jax.tree_util.tree_map(
+              jnp.asarray, TensorSpecStruct.from_flat_dict(f)),
+          jax.tree_util.tree_map(
+              jnp.asarray, TensorSpecStruct.from_flat_dict(l))))
+    losses = []
+    for i in range(200):
+      f, l = batches[i % len(batches)]
+      state, metrics = step(state, f, l, jax.random.PRNGKey(i))
+      losses.append(float(metrics["loss"]))
+    assert np.mean(losses[-8:]) < np.mean(losses[:8]) * 0.5, (
+        losses[:8], losses[-8:])
+
+  def test_predict_with_demo_actions(self):
+    model = VRGripperWTLModel(
+        policy_type="trial", image_size=IMG, filters=(8,),
+        embedding_size=16, hidden_sizes=(16,),
+        num_condition_samples_per_task=2,
+        num_inference_samples_per_task=3)
+    state = model.create_inference_state(jax.random.PRNGKey(0))
+    pf = make_random_tensors(
+        model.get_feature_specification(Mode.PREDICT),
+        batch_size=2, seed=0, include_optional=True)
+    outputs = jax.jit(model.predict_step)(
+        state, jax.tree_util.tree_map(jnp.asarray, pf))
+    assert outputs["action"].shape == (2, 3, 3)
+
+
+class TestShippedConfigs:
+
+  @pytest.mark.parametrize("name", [
+      "train_vrgripper_bc.gin",
+      "train_vrgripper_meta.gin",
+      "train_vrgripper_wtl.gin",
+  ])
+  def test_config_parses_and_builds_model(self, name):
+    from tensor2robot_tpu import config as gin
+    import tensor2robot_tpu.train_eval  # noqa: F401 registers
+    import tensor2robot_tpu.research.vrgripper  # noqa: F401
+    import tensor2robot_tpu.meta_learning  # noqa: F401
+    import tensor2robot_tpu.data  # noqa: F401
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tensor2robot_tpu", "research", "vrgripper", "configs", name)
+    gin.clear_config()
+    try:
+      gin.parse_config_files_and_bindings([path], [])
+      model = gin.query_parameter("train_eval_model.model").resolve()
+      assert model.get_feature_specification(Mode.TRAIN) is not None
+    finally:
+      gin.clear_config()
+
+
+class TestVRGripperEndToEnd:
+
+  def test_collect_train_eval(self, tmp_path):
+    from tensor2robot_tpu import train_eval
+    from tensor2robot_tpu.predictors import CheckpointPredictor
+
+    path = str(tmp_path / "demos.tfrecord")
+    # Noisy demos double as state coverage (DAgger-ish) — the clone
+    # must recover from off-expert states during closed-loop eval.
+    collect_demo_episodes(path, num_episodes=64, image_size=IMG,
+                          seed=0, action_noise=0.1)
+    model = tiny_bc_model()
+    model_dir = str(tmp_path / "model")
+    train_eval.train_eval_model(
+        model=model,
+        model_dir=model_dir,
+        input_generator_train=TransitionInputGenerator(
+            TFRecordEpisodeInputGenerator(
+                file_patterns=path, sequence_length=12, seed=1),
+            batch_size=32, seed=1),
+        max_train_steps=500,
+        batch_size=32,
+        save_checkpoints_steps=500,
+        log_every_steps=200,
+    )
+    predictor = CheckpointPredictor(model, checkpoint_dir=model_dir)
+    assert predictor.restore(timeout_secs=0)
+    metrics = evaluate_gripper_policy(
+        predictor.predict, num_episodes=20, image_size=IMG, seed=5)
+    # The scripted expert solves ~100%; a briefly-trained clone must
+    # clear a do-nothing baseline (~0 success) decisively.
+    assert metrics["success_rate"] >= 0.5, metrics
+
+  def test_meta_generator_feeds_snail(self, tmp_path):
+    path = str(tmp_path / "demos.tfrecord")
+    collect_demo_episodes(path, num_episodes=16, image_size=IMG,
+                          seed=0)
+    model = VRGripperSNAILModel(
+        image_size=IMG, filters=(8,), embedding_size=16,
+        snail_filters=8, num_condition_samples_per_task=3,
+        num_inference_samples_per_task=2)
+    gen = EpisodeMetaInputGenerator(
+        TFRecordEpisodeInputGenerator(
+            file_patterns=path, sequence_length=5, shuffle=False),
+        num_condition_samples_per_task=3,
+        num_inference_samples_per_task=2, batch_size=2)
+    gen.set_specification_from_model(model, Mode.TRAIN)
+    state = model.create_train_state(jax.random.PRNGKey(0))
+    f, l = next(gen.create_dataset(Mode.TRAIN))
+    f = jax.tree_util.tree_map(jnp.asarray, f)
+    l = jax.tree_util.tree_map(jnp.asarray, l)
+    state, metrics = jax.jit(model.train_step)(
+        state, f, l, jax.random.PRNGKey(0))
+    assert np.isfinite(float(metrics["loss"]))
