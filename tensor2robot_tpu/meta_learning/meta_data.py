@@ -12,18 +12,24 @@ by wrapping it.
 
 from __future__ import annotations
 
+import logging
 from typing import Iterator, Optional, Tuple
+
+import numpy as np
 
 from tensor2robot_tpu import config as gin
 from tensor2robot_tpu.data.abstract_input_generator import (
     AbstractInputGenerator,
     Mode,
 )
+from tensor2robot_tpu.data.tfexample import SEQUENCE_LENGTH_KEY
 from tensor2robot_tpu.meta_learning.maml_model import (
     CONDITION,
     INFERENCE,
 )
-from tensor2robot_tpu.specs import TensorSpecStruct
+from tensor2robot_tpu.specs import TensorSpecStruct, as_sequence_specs
+
+log = logging.getLogger(__name__)
 
 
 def make_meta_batch(features: TensorSpecStruct,
@@ -74,36 +80,48 @@ def meta_batch_from_episodes(features: TensorSpecStruct,
   The first `num_condition` timesteps become the condition set, the
   next `num_inference` the inference set — the reference's episode
   semantics (demonstration prefix conditions, later steps evaluate).
-  Requires every TRUE episode length (the parser's `sequence_length`
-  feature, when present) ≥ num_condition + num_inference — zero-padded
-  timesteps must never masquerade as data. Keys in `context_keys` are
+  Episodes whose TRUE length (the parser's `sequence_length` feature,
+  when present) is < num_condition + num_inference are DROPPED with a
+  logged warning — zero-padded timesteps must never masquerade as data,
+  and real ragged datasets shouldn't abort the iterator over one short
+  episode. If every episode in the batch is too short, raises (that is
+  a config error, not raggedness). Keys in `context_keys` are
   per-episode (no time axis); they are tiled across the per-task sample
   dim of both splits. The `sequence_length` key itself is consumed
   here, not forwarded.
   """
   need = num_condition + num_inference
-  import numpy as _np
   flat_f = features.to_flat_dict()
-  true_lengths = flat_f.get("sequence_length")
+  true_lengths = flat_f.get(SEQUENCE_LENGTH_KEY)
+  keep = None
   if true_lengths is not None:
-    short = _np.asarray(true_lengths) < need
-    if _np.any(short):
+    short = np.asarray(true_lengths) < need
+    if np.all(short):
       raise ValueError(
-          f"{int(short.sum())} episode(s) shorter than condition+"
+          f"Every episode in the batch is shorter than condition+"
           f"inference = {need} (true lengths "
-          f"{_np.asarray(true_lengths)[short].tolist()}); splitting "
-          f"them would train on zero padding.")
+          f"{np.asarray(true_lengths).tolist()}); splitting them would "
+          f"train on zero padding. Lower num_condition/num_inference or "
+          f"collect longer episodes.")
+    if np.any(short):
+      log.warning(
+          "Dropping %d/%d episode(s) shorter than condition+inference "
+          "= %d (true lengths %s).", int(short.sum()), short.size, need,
+          np.asarray(true_lengths)[short].tolist())
+      keep = ~short
 
   def nest(struct):
     if struct is None:
       return None
     out = {}
     for key, value in struct.to_flat_dict().items():
-      if key == "sequence_length":
+      if key == SEQUENCE_LENGTH_KEY:
         continue
+      if keep is not None:
+        value = value[keep]
       if key in context_keys:
-        cond = _np.repeat(value[:, None], num_condition, axis=1)
-        inf = _np.repeat(value[:, None], num_inference, axis=1)
+        cond = np.repeat(value[:, None], num_condition, axis=1)
+        inf = np.repeat(value[:, None], num_inference, axis=1)
         out[f"{CONDITION}/{key}"] = cond
         out[f"{INFERENCE}/{key}"] = inf
         continue
@@ -147,14 +165,9 @@ class EpisodeMetaInputGenerator(AbstractInputGenerator):
     # The episode wire carries the BASE specs per timestep.
     base_feat = base_model.get_feature_specification(mode)
     base_label = base_model.get_label_specification(mode)
-    as_sequence = lambda s: s.replace(is_sequence=True)  # noqa: E731
     self._episodes.set_specification(
-        TensorSpecStruct.from_flat_dict(
-            {k: as_sequence(v)
-             for k, v in base_feat.to_flat_dict().items()}),
-        TensorSpecStruct.from_flat_dict(
-            {k: as_sequence(v)
-             for k, v in base_label.to_flat_dict().items()})
+        as_sequence_specs(base_feat),
+        as_sequence_specs(base_label)
         if base_label is not None else None)
     self.set_specification(
         model.preprocessor.get_in_feature_specification(mode),
@@ -168,11 +181,61 @@ class EpisodeMetaInputGenerator(AbstractInputGenerator):
     context_keys = tuple(
         k for k, s in self._episodes.feature_spec.to_flat_dict().items()
         if not s.is_sequence)
+    # Short episodes are filtered HERE, buffering survivors across
+    # episode batches, so every emitted meta batch carries exactly
+    # `batch_size` tasks: a ragged dataset must neither abort the
+    # iterator (all-short batch) nor shrink the task dim (each distinct
+    # task count would retrace the jitted train step).
+    need = self._num_condition + self._num_inference
+    buf_f: dict = {}
+    buf_l: Optional[dict] = None
+    dropped = 0
+
+    def emit_from(joined_f, joined_l):
+      feats = TensorSpecStruct.from_flat_dict(joined_f)
+      labs = (TensorSpecStruct.from_flat_dict(joined_l)
+              if joined_l is not None else None)
+      return meta_batch_from_episodes(
+          feats, labs, self._num_condition, self._num_inference,
+          context_keys=context_keys)
+
     for features, labels in self._episodes.create_dataset(
         mode, batch_size=batch_size):
-      yield meta_batch_from_episodes(
-          features, labels, self._num_condition, self._num_inference,
-          context_keys=context_keys)
+      flat_f = features.to_flat_dict()
+      lengths = flat_f.get(SEQUENCE_LENGTH_KEY)
+      if lengths is not None:
+        keep = np.asarray(lengths) >= need
+        if not np.all(keep):
+          dropped += int((~keep).sum())
+          log.warning(
+              "Dropped %d episode(s) shorter than condition+inference "
+              "= %d (%d dropped so far).", int((~keep).sum()), need,
+              dropped)
+          flat_f = {k: v[keep] for k, v in flat_f.items()}
+          if labels is not None:
+            labels = TensorSpecStruct.from_flat_dict(
+                {k: v[keep] for k, v in labels.to_flat_dict().items()})
+          if not int(keep.sum()):
+            continue
+      for k, v in flat_f.items():
+        buf_f.setdefault(k, []).append(v)
+      if labels is not None:
+        buf_l = buf_l or {}
+        for k, v in labels.to_flat_dict().items():
+          buf_l.setdefault(k, []).append(v)
+      count = sum(a.shape[0] for a in buf_f[next(iter(buf_f))])
+      while count >= batch_size:
+        joined_f = {k: np.concatenate(v) for k, v in buf_f.items()}
+        joined_l = ({k: np.concatenate(v) for k, v in buf_l.items()}
+                    if buf_l else None)
+        out_f = {k: v[:batch_size] for k, v in joined_f.items()}
+        out_l = ({k: v[:batch_size] for k, v in joined_l.items()}
+                 if joined_l is not None else None)
+        buf_f = {k: [v[batch_size:]] for k, v in joined_f.items()}
+        if joined_l is not None:
+          buf_l = {k: [v[batch_size:]] for k, v in joined_l.items()}
+        count -= batch_size
+        yield emit_from(out_f, out_l)
 
 
 @gin.configurable

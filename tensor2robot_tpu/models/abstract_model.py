@@ -192,7 +192,8 @@ class AbstractT2RModel(ModelInterface):
     params = variables["params"]
     batch_stats = variables.get("batch_stats", {})
     if self._init_from_checkpoint_path:
-      params = self.maybe_init_from_checkpoint(params)
+      params, batch_stats = self.maybe_init_from_checkpoint(
+          params, batch_stats)
     return TrainState(
         step=jnp.zeros((), jnp.int32),
         params=params,
@@ -206,12 +207,23 @@ class AbstractT2RModel(ModelInterface):
     state = self.create_inference_state(rng, batch_size=batch_size)
     return state.replace(opt_state=self.tx.init(state.params))
 
-  def maybe_init_from_checkpoint(self, params):
-    """Warm-starts params from `init_from_checkpoint_path` (orbax)."""
+  def maybe_init_from_checkpoint(self, params, batch_stats=None):
+    """Warm-starts params (and BN stats) from `init_from_checkpoint_path`.
+
+    BN moving averages ride along when the model carries batch_stats —
+    warm-starting params alone would pair trained weights with
+    fresh-init statistics, the same silent degradation the predictor
+    path guards against.
+    """
     from tensor2robot_tpu.utils import checkpoints as ckpt_lib
+    if batch_stats:
+      variables = ckpt_lib.restore_variables(
+          self._init_from_checkpoint_path,
+          like={"params": params, "batch_stats": batch_stats})
+      return variables["params"], variables["batch_stats"]
     restored = ckpt_lib.restore_params(
         self._init_from_checkpoint_path, like=params)
-    return restored
+    return restored, batch_stats
 
   # ---- steps (pure; the trainer jits these) ----
 

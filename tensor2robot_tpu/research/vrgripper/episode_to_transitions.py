@@ -25,12 +25,13 @@ from tensor2robot_tpu.data.abstract_input_generator import (
     Mode,
 )
 from tensor2robot_tpu.data.tfexample import SEQUENCE_LENGTH_KEY
-from tensor2robot_tpu.specs import TensorSpecStruct
+from tensor2robot_tpu.specs import TensorSpecStruct, as_sequence_specs
 
 
 def episode_batch_to_transitions(
     features: TensorSpecStruct,
     labels: Optional[TensorSpecStruct],
+    sequence_keys: Optional[frozenset] = None,
 ) -> Tuple[TensorSpecStruct, Optional[TensorSpecStruct]]:
   """Flattens [B, T, ...] episode batches into [N, ...] transitions.
 
@@ -38,11 +39,30 @@ def episode_batch_to_transitions(
   pre-pad lengths from the episode parser) masks out padding. Without
   it, every timestep is assumed real. Keys without a time axis
   (per-episode context) are repeated across their episode's timesteps.
+
+  Args:
+    features: [B, T, ...] episode feature batch.
+    labels: matching label batch, or None.
+    sequence_keys: flat keys known (from specs) to carry a time axis.
+      When given, the time axis comes from a sequence key and context vs
+      sequence classification is exact. When None, the time axis falls
+      back to the first rank>=2 value — ambiguous if a [B, D] context
+      key precedes every sequence key, so spec-aware callers should
+      always pass it.
   """
   flat_f = features.to_flat_dict()
   lengths = flat_f.pop(SEQUENCE_LENGTH_KEY, None)
-  some = next(iter(flat_f.values()))
-  batch, time = some.shape[0], some.shape[1] if some.ndim > 1 else 1
+  anchor = None
+  if sequence_keys:
+    anchor = next((v for k, v in flat_f.items() if k in sequence_keys),
+                  None)
+    if labels is not None and anchor is None:
+      anchor = next((v for k, v in labels.to_flat_dict().items()
+                     if k in sequence_keys), None)
+  if anchor is None:
+    anchor = next((v for v in flat_f.values() if v.ndim >= 2),
+                  next(iter(flat_f.values())))
+  batch, time = anchor.shape[0], anchor.shape[1] if anchor.ndim > 1 else 1
   if lengths is None:
     mask = np.ones((batch, time), bool)
   else:
@@ -53,7 +73,13 @@ def episode_batch_to_transitions(
   def flatten(struct_flat):
     out = {}
     for key, value in struct_flat.items():
-      if value.ndim >= 2 and value.shape[:2] == (batch, time):
+      is_seq = (key in sequence_keys if sequence_keys is not None
+                else value.ndim >= 2 and value.shape[:2] == (batch, time))
+      if is_seq:
+        if value.shape[:2] != (batch, time):
+          raise ValueError(
+              f"{key!r} declared a sequence but has shape {value.shape}; "
+              f"expected leading dims {(batch, time)}.")
         flat = value.reshape((batch * time,) + value.shape[2:])
       else:
         # Per-episode context: repeat across the episode's timesteps.
@@ -86,6 +112,7 @@ class TransitionInputGenerator(AbstractInputGenerator):
     self._episodes = episode_generator
     self._shuffle = shuffle_transitions
     self._seed = seed
+    self._sequence_keys: Optional[frozenset] = None
 
   def set_specification_from_model(self, model, mode: Mode) -> None:
     # The model consumes flat transitions; the wire carries episodes of
@@ -98,11 +125,11 @@ class TransitionInputGenerator(AbstractInputGenerator):
     else:
       feat = model.get_feature_specification(mode)
       label = model.get_label_specification(mode)
-    as_seq = lambda st: TensorSpecStruct.from_flat_dict(  # noqa: E731
-        {k: v.replace(is_sequence=True)
-         for k, v in st.to_flat_dict().items()})
     self._episodes.set_specification(
-        as_seq(feat), as_seq(label) if label is not None else None)
+        as_sequence_specs(feat),
+        as_sequence_specs(label) if label is not None else None)
+    self._sequence_keys = frozenset(feat.to_flat_dict()) | frozenset(
+        label.to_flat_dict() if label is not None else ())
     self.set_specification(feat, label)
 
   def _create_dataset(self, mode: Mode, batch_size: int
@@ -115,7 +142,7 @@ class TransitionInputGenerator(AbstractInputGenerator):
     for ep_features, ep_labels in self._episodes.create_dataset(
         mode, batch_size=episode_batch):
       features, labels = episode_batch_to_transitions(
-          ep_features, ep_labels)
+          ep_features, ep_labels, sequence_keys=self._sequence_keys)
       flat_f = features.to_flat_dict()
       for k, v in flat_f.items():
         buf_f.setdefault(k, []).append(v)

@@ -9,6 +9,7 @@ from tensor2robot_tpu.specs.tensorspec import (
 from tensor2robot_tpu.specs.packing import (
     SpecValidationError,
     add_sequence_length,
+    as_sequence_specs,
     assert_valid_spec_structure,
     filter_required_flat_tensor_spec_structure,
     flatten_spec_structure,

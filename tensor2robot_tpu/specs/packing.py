@@ -226,6 +226,20 @@ def to_shape_dtype_structs(
   })
 
 
+def as_sequence_specs(spec_structure: Any) -> TensorSpecStruct:
+  """Lifts every spec in a structure to a per-timestep sequence spec.
+
+  Episode pipelines record a model's (per-step) feature/label specs once
+  per timestep on the wire; this helper marks every leaf `is_sequence`
+  so SequenceExample codecs and episode generators treat the data as
+  [time, ...] feature_lists (reference: tensor2robot `meta_tfdata.py`
+  episode batching — file:line unavailable, see SURVEY.md provenance).
+  """
+  flat = flatten_spec_structure(spec_structure).to_flat_dict()
+  return TensorSpecStruct.from_flat_dict(
+      {k: v.replace(is_sequence=True) for k, v in flat.items()})
+
+
 def add_sequence_length(
     spec_structure: Any, sequence_length: int) -> TensorSpecStruct:
   """Materializes sequence specs to fixed-length specs (time-major-after-batch).

@@ -254,16 +254,64 @@ class TestEpisodeGenerator:
       meta_batch_from_episodes(features, None, num_condition=4,
                                num_inference=2)
 
-  def test_padded_short_episode_rejected_via_true_lengths(self):
+  def test_padded_short_episode_dropped_via_true_lengths(self):
     # A zero-padded [B, 16, ...] batch LOOKS long enough; the true
-    # lengths say otherwise and must win.
+    # lengths say otherwise: short episodes are dropped (ragged real
+    # datasets must not abort the iterator), all-short raises.
     from tensor2robot_tpu.meta_learning import meta_batch_from_episodes
+    state = np.zeros((2, 16, 3), np.float32)
+    state[1] = 7.0
     features = TensorSpecStruct.from_flat_dict({
-        "state": np.zeros((2, 16, 3), np.float32),
+        "state": state,
         "sequence_length": np.array([3, 16], np.int32)})
+    mf, _ = meta_batch_from_episodes(features, None, num_condition=4,
+                                     num_inference=4)
+    assert mf["condition/state"].shape == (1, 4, 3)
+    np.testing.assert_array_equal(mf["condition/state"],
+                                  state[1:2, :4])
+    all_short = TensorSpecStruct.from_flat_dict({
+        "state": np.zeros((2, 16, 3), np.float32),
+        "sequence_length": np.array([3, 5], np.int32)})
     with pytest.raises(ValueError, match="zero padding"):
-      meta_batch_from_episodes(features, None, num_condition=4,
+      meta_batch_from_episodes(all_short, None, num_condition=4,
                                num_inference=4)
+
+  def test_meta_generator_constant_task_dim_under_raggedness(self):
+    # Ragged datasets must not shrink the task dim (every distinct task
+    # count would retrace the jitted step) nor abort on an all-short
+    # batch: the generator buffers surviving episodes across batches.
+    from tensor2robot_tpu.meta_learning import EpisodeMetaInputGenerator
+    from tensor2robot_tpu.data.abstract_input_generator import (
+        AbstractInputGenerator,
+    )
+
+    spec = TensorSpecStruct.from_flat_dict({
+        "state": ExtendedTensorSpec(shape=(3,), dtype=np.float32,
+                                    name="state", is_sequence=True)})
+
+    class RaggedEpisodes(AbstractInputGenerator):
+      # Batches of 2 episodes with true lengths cycling through a
+      # pattern that includes an ALL-short batch.
+      lengths = [(8, 3), (2, 2), (8, 8), (3, 8)]
+
+      def _create_dataset(self, mode, batch_size):
+        i = 0
+        while True:
+          lens = self.lengths[i % len(self.lengths)]
+          i += 1
+          yield (TensorSpecStruct.from_flat_dict({
+              "state": np.full((2, 8, 3), i, np.float32),
+              "sequence_length": np.array(lens, np.int32)}), None)
+
+    inner = RaggedEpisodes()
+    inner.set_specification(spec)
+    gen = EpisodeMetaInputGenerator(
+        inner, num_condition_samples_per_task=4,
+        num_inference_samples_per_task=4, batch_size=2)
+    gen.set_specification(spec)
+    it = gen.create_dataset(Mode.TRAIN, batch_size=2)
+    shapes = [next(it)[0]["condition/state"].shape for _ in range(4)]
+    assert shapes == [(2, 4, 3)] * 4
 
   def test_context_keys_tiled_not_sliced(self):
     from tensor2robot_tpu.meta_learning import meta_batch_from_episodes

@@ -173,6 +173,42 @@ class TestWarmStart:
             np.asarray(a), np.asarray(b)),
         state.params, warm_state.params)
 
+  def test_warm_start_restores_batch_stats(self, tmp_path):
+    """Warm-started BN models must inherit the checkpoint's moving
+    averages, not keep fresh-init ones (the predictor-path guarantee,
+    extended to maybe_init_from_checkpoint)."""
+    from tensor2robot_tpu.research.pose_env import PoseEnvRegressionModel
+    from tensor2robot_tpu.specs import make_random_tensors
+    from tensor2robot_tpu.utils import checkpoints as ckpt_lib
+
+    model = PoseEnvRegressionModel(
+        image_size=16, filters=(4,), embedding_size=8, hidden_sizes=(8,),
+        use_batch_norm=True)
+    state = model.create_train_state(jax.random.PRNGKey(0), batch_size=4)
+    batch = make_random_tensors(
+        model.preprocessor.get_in_feature_specification(Mode.TRAIN),
+        batch_size=4, seed=1)
+    labels = make_random_tensors(
+        model.preprocessor.get_in_label_specification(Mode.TRAIN),
+        batch_size=4, seed=2)
+    for i in range(3):
+      state, _ = jax.jit(model.train_step)(
+          state, batch, labels, jax.random.PRNGKey(i))
+    writer = ckpt_lib.CheckpointWriter(str(tmp_path))
+    writer.save(3, jax.device_get(state))
+    writer.close()
+
+    warm = PoseEnvRegressionModel(
+        image_size=16, filters=(4,), embedding_size=8, hidden_sizes=(8,),
+        use_batch_norm=True, init_from_checkpoint_path=str(tmp_path))
+    warm_state = warm.create_train_state(jax.random.PRNGKey(9),
+                                         batch_size=4)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6),
+        jax.device_get(state.batch_stats),
+        jax.device_get(warm_state.batch_stats))
+
   def test_predictor_restores_batch_stats(self, tmp_path):
     """BN moving averages must survive the trainer→predictor handoff."""
     from tensor2robot_tpu.predictors import CheckpointPredictor
