@@ -122,3 +122,44 @@ def make_q_score_fn(
     return q.reshape(b, p)
 
   return score_fn
+
+
+def make_encoded_q_score_fn(
+    network,
+    variables,
+    state_features,
+    q_key: str = "q_value",
+) -> Callable[[jax.Array], jax.Array]:
+  """Score fn exploiting an encode/head-split Q-network.
+
+  The action-independent torso (`network.encode`) runs ONCE per state;
+  only its (small) output feature map is tiled over the CEM population
+  and fed to `network.head` per candidate. The naive path re-convolves
+  the full image population × iterations times per action choice — at
+  QT-Opt scale (population 64) that is ~64× redundant torso compute.
+  """
+  flat_state = dict(state_features.to_flat_dict()
+                    if hasattr(state_features, "to_flat_dict")
+                    else state_features)
+  image = flat_state.pop("image")
+  encoded = network.apply(variables, image, train=False,
+                          method="encode")
+
+  def score_fn(actions: jax.Array) -> jax.Array:
+    b, p, a = actions.shape
+    flat_actions = actions.reshape(b * p, a)
+
+    def tile(x):
+      reps = (1, p) + (1,) * (x.ndim - 1)
+      return jnp.tile(x[:, None], reps).reshape((b * p,) + x.shape[1:])
+
+    flat = {k: tile(v) for k, v in flat_state.items()}
+    flat["action"] = flat_actions
+    from tensor2robot_tpu.specs import TensorSpecStruct
+    features = TensorSpecStruct.from_flat_dict(flat)
+    outputs = network.apply(variables, tile(encoded), features,
+                            train=False, method="head")
+    q = outputs[q_key] if isinstance(outputs, dict) else outputs
+    return q.reshape(b, p)
+
+  return score_fn

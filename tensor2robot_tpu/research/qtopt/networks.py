@@ -12,6 +12,13 @@ then a dense head to a scalar logit.
 TPU-first: NHWC bf16 convs sized in MXU-friendly multiples, uint8
 images cast+scaled on device, the action merge is a 1×1-conv-equivalent
 dense broadcast (fuses into the surrounding convs), no dynamic shapes.
+
+The network is split at the action merge into two callable halves:
+`encode(image)` — everything action-independent — and
+`head(encoded, features)` — action embed + conv head + dense. CEM
+exploits the split: the torso runs ONCE per state and only the (much
+cheaper) head runs per population candidate, instead of re-convolving
+the full image population × iterations times per Bellman target.
 """
 
 from __future__ import annotations
@@ -26,6 +33,21 @@ from tensor2robot_tpu.layers import MLP
 from tensor2robot_tpu.models.critic_model import Q_VALUE
 
 
+def _gather_action_extras(features, dtype):
+  """Flattens action + every non-image float feature, sorted by key."""
+  flat = (features.to_flat_dict() if hasattr(features, "to_flat_dict")
+          else dict(features))
+  action = flat["action"]
+  extras = [action.reshape(action.shape[0], -1).astype(dtype)]
+  for key in sorted(flat):
+    if key in ("image", "action"):
+      continue
+    value = flat[key]
+    if jnp.issubdtype(value.dtype, jnp.floating):
+      extras.append(value.reshape(value.shape[0], -1).astype(dtype))
+  return jnp.concatenate(extras, axis=-1)
+
+
 class GraspingQNetwork(nn.Module):
   """Image + action → Q logit, QT-Opt-paper style."""
 
@@ -36,54 +58,63 @@ class GraspingQNetwork(nn.Module):
   use_batch_norm: bool = True
   dtype: Any = jnp.bfloat16
 
-  @nn.compact
-  def __call__(self, features, train: bool = False):
-    image = features["image"]
-    action = features["action"]
-    x = image.astype(self.dtype) / jnp.asarray(255.0, self.dtype)
-
+  def setup(self):
+    conv = lambda f, name: nn.Conv(  # noqa: E731
+        f, (3, 3), strides=(2, 2), padding="SAME",
+        use_bias=not self.use_batch_norm, dtype=self.dtype, name=name)
     norm = lambda name: nn.BatchNorm(  # noqa: E731
-        use_running_average=not train, momentum=0.9, dtype=self.dtype,
-        name=name)
+        momentum=0.9, dtype=self.dtype, name=name)
+    self._torso_convs = [conv(f, f"torso_conv_{i}")
+                         for i, f in enumerate(self.torso_filters)]
+    self._torso_bns = ([norm(f"torso_bn_{i}")
+                        for i in range(len(self.torso_filters))]
+                       if self.use_batch_norm else [])
+    self._head_convs = [conv(f, f"head_conv_{i}")
+                        for i, f in enumerate(self.head_filters)]
+    self._head_bns = ([norm(f"head_bn_{i}")
+                       for i in range(len(self.head_filters))]
+                      if self.use_batch_norm else [])
+    self._action_embed_0 = nn.Dense(
+        self.action_embedding_size, dtype=self.dtype,
+        name="action_embed_0")
+    # The merge adds the embedded action onto the torso's output
+    # channels (3 = raw RGB when the torso is empty).
+    merge_channels = (self.torso_filters[-1] if self.torso_filters
+                      else 3)
+    self._action_embed_1 = nn.Dense(
+        merge_channels, dtype=self.dtype, name="action_embed_1")
+    self._q_head = MLP(hidden_sizes=tuple(self.dense_sizes),
+                       output_size=1, dtype=self.dtype, name="q_head")
 
-    # Conv torso over the image alone.
-    for i, f in enumerate(self.torso_filters):
-      x = nn.Conv(f, (3, 3), strides=(2, 2), padding="SAME",
-                  use_bias=not self.use_batch_norm, dtype=self.dtype,
-                  name=f"torso_conv_{i}")(x)
+  def encode(self, image, train: bool = False):
+    """Action-independent half: image → torso feature map [B,h,w,C].
+
+    CEM callers run this once per state and tile the (small) result
+    over the candidate population instead of the full image.
+    """
+    x = image.astype(self.dtype) / jnp.asarray(255.0, self.dtype)
+    for i, conv in enumerate(self._torso_convs):
+      x = conv(x)
       if self.use_batch_norm:
-        x = norm(f"torso_bn_{i}")(x)
+        x = self._torso_bns[i](x, use_running_average=not train)
       x = nn.relu(x)
+    return x
 
-    # Action (plus any extra flat float features) embedded and
-    # broadcast-added into the spatial features — the paper's merge.
-    extras = [action.reshape(action.shape[0], -1).astype(self.dtype)]
-    for key in sorted(features.to_flat_dict()
-                      if hasattr(features, "to_flat_dict") else features):
-      if key in ("image", "action"):
-        continue
-      value = (features.to_flat_dict() if hasattr(features, "to_flat_dict")
-               else features)[key]
-      if jnp.issubdtype(value.dtype, jnp.floating):
-        extras.append(value.reshape(value.shape[0], -1).astype(self.dtype))
-    a = jnp.concatenate(extras, axis=-1)
-    a = nn.Dense(self.action_embedding_size, dtype=self.dtype,
-                 name="action_embed_0")(a)
-    a = nn.relu(a)
-    a = nn.Dense(x.shape[-1], dtype=self.dtype,
-                 name="action_embed_1")(a)
-    x = x + a[:, None, None, :]
-
-    # Conv head over the merged features.
-    for i, f in enumerate(self.head_filters):
-      x = nn.Conv(f, (3, 3), strides=(2, 2), padding="SAME",
-                  use_bias=not self.use_batch_norm, dtype=self.dtype,
-                  name=f"head_conv_{i}")(x)
+  def head(self, encoded, features, train: bool = False):
+    """Action-dependent half: (torso features, action+extras) → Q."""
+    a = _gather_action_extras(features, self.dtype)
+    a = nn.relu(self._action_embed_0(a))
+    a = self._action_embed_1(a)
+    x = encoded + a[:, None, None, :]
+    for i, conv in enumerate(self._head_convs):
+      x = conv(x)
       if self.use_batch_norm:
-        x = norm(f"head_bn_{i}")(x)
+        x = self._head_bns[i](x, use_running_average=not train)
       x = nn.relu(x)
-
     x = jnp.mean(x, axis=(1, 2))
-    logit = MLP(hidden_sizes=tuple(self.dense_sizes), output_size=1,
-                dtype=self.dtype, name="q_head")(x, train=train)
+    logit = self._q_head(x, train=train)
     return {Q_VALUE: logit[..., 0].astype(jnp.float32)}
+
+  def __call__(self, features, train: bool = False):
+    encoded = self.encode(features["image"], train=train)
+    return self.head(encoded, features, train=train)

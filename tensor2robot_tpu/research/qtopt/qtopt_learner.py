@@ -87,6 +87,16 @@ class QTOptLearner:
     target = jax.tree_util.tree_map(jnp.copy, train_state.params)
     return QTOptState(train_state=train_state, target_params=target)
 
+  def _score_fn(self, variables, state_features):
+    """CEM score fn; encode-once when the network is split that way."""
+    network = self._model.network
+    if hasattr(network, "encode") and hasattr(network, "head"):
+      return cem.make_encoded_q_score_fn(
+          network, variables, state_features, q_key=Q_VALUE)
+    return cem.make_q_score_fn(
+        functools.partial(network.apply), variables, state_features,
+        q_key=Q_VALUE)
+
   # ---- target computation ----
 
   def _target_q_values(self, target_params, batch_stats,
@@ -97,9 +107,7 @@ class QTOptLearner:
     if batch_stats:
       variables["batch_stats"] = batch_stats
     batch = jax.tree_util.tree_leaves(next_features)[0].shape[0]
-    score_fn = cem.make_q_score_fn(
-        functools.partial(self._model.network.apply),
-        variables, next_features, q_key=Q_VALUE)
+    score_fn = self._score_fn(variables, next_features)
 
     def sigmoid_score(actions):
       q = score_fn(actions)
@@ -177,9 +185,7 @@ class QTOptLearner:
       if ts.batch_stats:
         variables["batch_stats"] = ts.batch_stats
       batch = jax.tree_util.tree_leaves(observations)[0].shape[0]
-      score_fn = cem.make_q_score_fn(
-          functools.partial(self._model.network.apply),
-          variables, observations, q_key=Q_VALUE)
+      score_fn = self._score_fn(variables, observations)
       result = cem.cem_maximize(
           score_fn, rng, batch, self._model.action_dim,
           iterations=iterations, population=population,
