@@ -93,6 +93,11 @@ class ResNet(nn.Module):
   `film_conditioning` (a (B, D) vector passed at call time) modulates
   every block when `use_film=True` — the film_resnet variant used by
   conditioned policies.
+
+  `return_spatial=True` additionally returns the final pre-pool feature
+  map `(B, H, W, C)` — grasp2vec's localization heatmaps correlate goal
+  embeddings against it (reference `research/grasp2vec/` visualization;
+  SURVEY.md §3).
   """
 
   stage_sizes: Sequence[int] = (2, 2, 2, 2)
@@ -100,12 +105,13 @@ class ResNet(nn.Module):
   block_cls: Any = ResNetBlock
   num_classes: Optional[int] = None
   use_film: bool = False
+  return_spatial: bool = False
   dtype: Any = jnp.float32
 
   @nn.compact
   def __call__(self, images: jax.Array,
                conditioning: Optional[jax.Array] = None,
-               train: bool = False) -> jax.Array:
+               train: bool = False) -> Any:
     x = images.astype(self.dtype)
     x = nn.Conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
                 use_bias=False, dtype=self.dtype, name="conv_init")(x)
@@ -123,9 +129,12 @@ class ResNet(nn.Module):
             dtype=self.dtype,
             name=f"stage{i}_block{j}",
         )(x, conditioning=conditioning, train=train)
+    spatial = x
     x = jnp.mean(x, axis=(1, 2))
     if self.num_classes is not None:
       x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+    if self.return_spatial:
+      return x.astype(jnp.float32), spatial.astype(jnp.float32)
     return x.astype(jnp.float32)
 
 

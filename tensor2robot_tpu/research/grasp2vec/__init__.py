@@ -1,0 +1,24 @@
+"""Grasp2Vec research family (reference: research/grasp2vec/)."""
+
+from tensor2robot_tpu.research.grasp2vec.grasp2vec_model import (
+    GOAL_EMBEDDING,
+    GOAL_REWARD,
+    Grasp2VecModel,
+    POSTGRASP_EMBEDDING,
+    PREGRASP_EMBEDDING,
+    SCENE_SPATIAL,
+)
+from tensor2robot_tpu.research.grasp2vec.grasp_env import (
+    GraspSceneGenerator,
+    collect_grasp_triplets,
+    evaluate_retrieval,
+)
+from tensor2robot_tpu.research.grasp2vec.losses import (
+    cosine_similarity,
+    goal_similarity_reward,
+    npairs_loss,
+)
+from tensor2robot_tpu.research.grasp2vec.visualization import (
+    goal_localization_heatmap,
+    heatmap_argmax,
+)

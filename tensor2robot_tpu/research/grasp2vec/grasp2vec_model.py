@@ -1,0 +1,188 @@
+"""Grasp2Vec: self-supervised object embeddings from grasping.
+
+Reference parity: tensor2robot `research/grasp2vec/grasp2vec_model.py` —
+`Grasp2VecModel` with scene tower φ and outcome tower ψ trained so that
+φ(pregrasp) − φ(postgrasp) ≈ ψ(outcome) under an NPairs loss, enabling
+goal-conditioned retrieval and embedding arithmetic (SURVEY.md §3
+"Grasp2Vec" row; file:line unavailable — empty reference mount; paper:
+arXiv:1811.06964).
+
+TPU-first design decisions:
+  * Pregrasp and postgrasp images run through the SAME scene tower in
+    ONE batched pass (stacked on the batch axis) — a single conv
+    program at 2B batch keeps the MXU fed instead of two half-size
+    dispatches.
+  * Embeddings come from ReLU'd 1×1-conv features mean-pooled over
+    space: non-negative and additive, so scene embeddings compose as
+    sums of object embeddings (the arithmetic the loss exploits) and
+    the pre-pool map doubles as a localization heatmap basis.
+  * uint8 images cross the host→device boundary; normalization fuses
+    into the first conv.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu import config as gin
+from tensor2robot_tpu.data.abstract_input_generator import Mode
+from tensor2robot_tpu.layers import ResNet, ResNetBlock
+from tensor2robot_tpu.models.abstract_model import AbstractT2RModel
+from tensor2robot_tpu.research.grasp2vec import losses as g2v_losses
+from tensor2robot_tpu.specs import ExtendedTensorSpec, TensorSpecStruct
+
+PREGRASP_EMBEDDING = "pregrasp_embedding"
+POSTGRASP_EMBEDDING = "postgrasp_embedding"
+GOAL_EMBEDDING = "goal_embedding"
+SCENE_SPATIAL = "scene_spatial"
+GOAL_REWARD = "goal_similarity"
+
+
+class _EmbeddingTower(nn.Module):
+  """ResNet trunk → 1×1 conv to embedding channels → ReLU → mean pool.
+
+  Returns (embedding (B, D), spatial map (B, H, W, D)). The ReLU before
+  pooling keeps per-location contributions non-negative, which is what
+  makes scene embeddings behave additively over objects.
+  """
+
+  stage_sizes: Sequence[int]
+  num_filters: int
+  embedding_size: int
+  dtype: Any = jnp.bfloat16
+
+  @nn.compact
+  def __call__(self, images: jax.Array,
+               train: bool = False) -> Tuple[jax.Array, jax.Array]:
+    x = images.astype(self.dtype) / jnp.asarray(255.0, self.dtype)
+    _, spatial = ResNet(
+        stage_sizes=tuple(self.stage_sizes),
+        num_filters=self.num_filters,
+        block_cls=ResNetBlock,
+        num_classes=None,
+        return_spatial=True,
+        dtype=self.dtype,
+        name="trunk",
+    )(x, train=train)
+    spatial = nn.Conv(self.embedding_size, (1, 1), dtype=self.dtype,
+                      name="embed")(spatial.astype(self.dtype))
+    spatial = nn.relu(spatial).astype(jnp.float32)
+    embedding = jnp.mean(spatial, axis=(1, 2))
+    return embedding, spatial
+
+
+class _Grasp2VecNetwork(nn.Module):
+  """Scene tower φ (shared for pre/post) + outcome tower ψ."""
+
+  stage_sizes: Sequence[int]
+  num_filters: int
+  embedding_size: int
+  dtype: Any = jnp.bfloat16
+
+  @nn.compact
+  def __call__(self, features, train: bool = False) -> Dict[str, Any]:
+    scene_tower = _EmbeddingTower(
+        stage_sizes=self.stage_sizes, num_filters=self.num_filters,
+        embedding_size=self.embedding_size, dtype=self.dtype,
+        name="scene_tower")
+    goal_tower = _EmbeddingTower(
+        stage_sizes=self.stage_sizes, num_filters=self.num_filters,
+        embedding_size=self.embedding_size, dtype=self.dtype,
+        name="goal_tower")
+
+    pre = features["pregrasp_image"]
+    post = features["postgrasp_image"]
+    batch = pre.shape[0]
+    # One 2B-batch pass through φ instead of two B-batch dispatches.
+    stacked = jnp.concatenate([pre, post], axis=0)
+    scene_emb, scene_spatial = scene_tower(stacked, train=train)
+    pre_emb, post_emb = scene_emb[:batch], scene_emb[batch:]
+    goal_emb, _ = goal_tower(features["goal_image"], train=train)
+    return {
+        PREGRASP_EMBEDDING: pre_emb,
+        POSTGRASP_EMBEDDING: post_emb,
+        GOAL_EMBEDDING: goal_emb,
+        SCENE_SPATIAL: scene_spatial[:batch],
+        GOAL_REWARD: g2v_losses.goal_similarity_reward(
+            pre_emb, post_emb, goal_emb),
+    }
+
+
+@gin.configurable
+class Grasp2VecModel(AbstractT2RModel):
+  """Self-supervised scene/outcome embedding model.
+
+  Features: pregrasp scene, postgrasp scene, and outcome ("goal") image
+  of the grasped object. Label: an integer `object_id`, used ONLY for
+  duplicate-aware loss targets and retrieval metrics — the training
+  signal itself is self-supervised embedding arithmetic.
+  """
+
+  def __init__(self,
+               image_size: int = 64,
+               goal_image_size: Optional[int] = None,
+               embedding_size: int = 128,
+               stage_sizes: Sequence[int] = (2, 2, 2, 2),
+               num_filters: int = 64,
+               reg_lambda: float = 0.002,
+               device_dtype=jnp.bfloat16,
+               **kwargs):
+    super().__init__(device_dtype=device_dtype, **kwargs)
+    self._image_size = image_size
+    self._goal_image_size = goal_image_size or image_size
+    self._embedding_size = embedding_size
+    self._stage_sizes = tuple(stage_sizes)
+    self._num_filters = num_filters
+    self._reg_lambda = reg_lambda
+
+  @property
+  def embedding_size(self) -> int:
+    return self._embedding_size
+
+  def get_feature_specification(self, mode: Mode) -> TensorSpecStruct:
+    st = TensorSpecStruct()
+    scene_shape = (self._image_size, self._image_size, 3)
+    goal_shape = (self._goal_image_size, self._goal_image_size, 3)
+    st.pregrasp_image = ExtendedTensorSpec(
+        shape=scene_shape, dtype=np.uint8, name="pregrasp_image",
+        data_format="jpeg")
+    st.postgrasp_image = ExtendedTensorSpec(
+        shape=scene_shape, dtype=np.uint8, name="postgrasp_image",
+        data_format="jpeg")
+    st.goal_image = ExtendedTensorSpec(
+        shape=goal_shape, dtype=np.uint8, name="goal_image",
+        data_format="jpeg")
+    return st
+
+  def get_label_specification(
+      self, mode: Mode) -> Optional[TensorSpecStruct]:
+    if mode == Mode.PREDICT:
+      return None
+    st = TensorSpecStruct()
+    st.object_id = ExtendedTensorSpec(
+        shape=(), dtype=np.int64, name="object_id")
+    return st
+
+  def create_network(self) -> nn.Module:
+    return _Grasp2VecNetwork(
+        stage_sizes=self._stage_sizes,
+        num_filters=self._num_filters,
+        embedding_size=self._embedding_size,
+        dtype=self.device_dtype,
+    )
+
+  def model_train_fn(self, features, labels, outputs, mode
+                     ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    anchor = (outputs[PREGRASP_EMBEDDING]
+              - outputs[POSTGRASP_EMBEDDING])
+    object_ids = labels["object_id"] if labels is not None else None
+    loss, metrics = g2v_losses.npairs_loss(
+        anchor, outputs[GOAL_EMBEDDING], object_ids=object_ids,
+        reg_lambda=self._reg_lambda)
+    metrics["goal_similarity"] = jnp.mean(outputs[GOAL_REWARD])
+    return loss, metrics

@@ -1,0 +1,47 @@
+"""Goal localization heatmaps from Grasp2Vec embeddings.
+
+Reference parity: tensor2robot `research/grasp2vec/visualization.py` —
+correlating an outcome embedding ψ(goal) against the scene tower's
+spatial feature map to localize "where is this object in the scene"
+(SURVEY.md §3 "Grasp2Vec" row; the paper's Figure-4 heatmaps).
+
+Pure jnp: composes into jitted eval/serving programs; also callable on
+numpy inputs host-side for visualization dumps.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def goal_localization_heatmap(
+    scene_spatial: jax.Array,
+    goal_embedding: jax.Array,
+    temperature: float = 1.0,
+) -> jax.Array:
+  """Softmax heatmap of goal-embedding correlation over scene locations.
+
+  Args:
+    scene_spatial: (B, H, W, D) pre-pool scene features (the model's
+      `scene_spatial` output).
+    goal_embedding: (B, D) outcome embeddings ψ(goal).
+    temperature: softmax temperature; lower = sharper peaks.
+
+  Returns (B, H, W) heatmaps, each summing to 1.
+  """
+  scene = scene_spatial.astype(jnp.float32)
+  goal = goal_embedding.astype(jnp.float32)
+  scores = jnp.einsum("bhwd,bd->bhw", scene, goal)
+  b, h, w = scores.shape
+  flat = scores.reshape(b, h * w) / jnp.maximum(temperature, 1e-6)
+  return jax.nn.softmax(flat, axis=-1).reshape(b, h, w)
+
+
+def heatmap_argmax(heatmap: jax.Array) -> Tuple[jax.Array, jax.Array]:
+  """Peak (row, col) per heatmap — grasp-point proposal from a goal."""
+  b, h, w = heatmap.shape
+  idx = jnp.argmax(heatmap.reshape(b, h * w), axis=-1)
+  return idx // w, idx % w

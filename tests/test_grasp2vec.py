@@ -1,0 +1,238 @@
+"""Tests for the Grasp2Vec research family.
+
+Same learning-sanity depth as the other families (SURVEY.md §5): the
+synthetic scenes have real compositional structure, so the tests assert
+that embedding arithmetic actually learns — retrieval decisively beats
+chance through the predictor, matched goals out-score mismatched ones —
+not just that shapes line up.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensor2robot_tpu import train_eval
+from tensor2robot_tpu.data.abstract_input_generator import Mode
+from tensor2robot_tpu.data.tfrecord_input_generator import (
+    TFRecordInputGenerator,
+)
+from tensor2robot_tpu.models import optimizers as opt_lib
+from tensor2robot_tpu.predictors import CheckpointPredictor
+from tensor2robot_tpu.research.grasp2vec import (
+    GOAL_EMBEDDING,
+    GOAL_REWARD,
+    Grasp2VecModel,
+    GraspSceneGenerator,
+    POSTGRASP_EMBEDDING,
+    PREGRASP_EMBEDDING,
+    SCENE_SPATIAL,
+    collect_grasp_triplets,
+    evaluate_retrieval,
+    goal_localization_heatmap,
+    goal_similarity_reward,
+    heatmap_argmax,
+    npairs_loss,
+)
+
+IMG = 32
+NUM_TYPES = 4
+
+
+def tiny_model(**kwargs):
+  kwargs.setdefault(
+      "create_optimizer_fn",
+      lambda: opt_lib.create_optimizer(learning_rate=1e-3))
+  return Grasp2VecModel(
+      image_size=IMG, embedding_size=32, stage_sizes=(1,),
+      num_filters=8, **kwargs)
+
+
+class TestSceneGenerator:
+
+  def test_triplet_shapes_and_structure(self):
+    gen = GraspSceneGenerator(image_size=IMG, num_object_types=NUM_TYPES,
+                              num_distractors=2, seed=0)
+    t = gen.sample()
+    for key in ("pregrasp_image", "postgrasp_image", "goal_image"):
+      assert t[key].shape == (IMG, IMG, 3)
+      assert t[key].dtype == np.uint8
+    # Post differs from pre exactly where the target was removed.
+    diff = np.any(t["pregrasp_image"] != t["postgrasp_image"], axis=-1)
+    assert diff.any()
+    cy, cx = np.argwhere(diff).mean(axis=0)
+    tx, ty = t["target_center"]
+    # Painted region centers on target_center (paint is [y, x]-indexed).
+    assert abs(cy - ty) < 3 and abs(cx - tx) < 3
+
+  def test_goal_gallery_one_image_per_type(self):
+    gen = GraspSceneGenerator(image_size=IMG, num_object_types=NUM_TYPES)
+    gallery = gen.goal_gallery()
+    assert gallery.shape == (NUM_TYPES, IMG, IMG, 3)
+    # All gallery entries pairwise distinct (distinct palette colors).
+    for i in range(NUM_TYPES):
+      for j in range(i + 1, NUM_TYPES):
+        assert (gallery[i] != gallery[j]).any()
+
+
+class TestNPairsLoss:
+
+  def test_aligned_embeddings_score_lower(self):
+    rng = np.random.default_rng(0)
+    emb = jnp.asarray(rng.normal(size=(8, 16)).astype(np.float32))
+    aligned, _ = npairs_loss(emb, emb)
+    shuffled, _ = npairs_loss(emb, jnp.roll(emb, 3, axis=0))
+    assert float(aligned) < float(shuffled)
+
+  def test_duplicate_ids_are_not_penalized(self):
+    emb = jnp.eye(4, 8, dtype=jnp.float32) * 4.0
+    # Rows 0 and 1 are the same object: retrieval of either is correct.
+    ids = jnp.asarray([7, 7, 2, 3])
+    dup = emb.at[1].set(emb[0])
+    loss_dup, metrics = npairs_loss(dup, dup, object_ids=ids)
+    assert float(metrics["retrieval_top1"]) == 1.0
+    loss_unique, _ = npairs_loss(emb, emb, object_ids=None)
+    # Duplicates with id-aware targets shouldn't blow the loss up vs
+    # the unique-rows case.
+    assert float(loss_dup) < float(loss_unique) + 1.0
+
+  def test_goal_similarity_reward_signs(self):
+    d = 8
+    obj = jnp.zeros((1, d)).at[0, 2].set(3.0)
+    pre = obj + 1.0
+    post = jnp.ones((1, d))
+    match = goal_similarity_reward(pre, post, obj)
+    mismatch = goal_similarity_reward(
+        pre, post, jnp.zeros((1, d)).at[0, 5].set(3.0))
+    assert float(match[0]) > 0.99
+    assert float(mismatch[0]) < 0.1
+
+
+class TestHeatmap:
+
+  def test_localization_peaks_at_matching_location(self):
+    b, h, w, d = 2, 5, 6, 8
+    spatial = np.zeros((b, h, w, d), np.float32)
+    goal = np.zeros((b, d), np.float32)
+    goal[0, 1] = 1.0
+    goal[1, 3] = 1.0
+    spatial[0, 2, 4, 1] = 5.0   # object 0 lives at (2, 4)
+    spatial[1, 4, 0, 3] = 5.0
+    heat = goal_localization_heatmap(
+        jnp.asarray(spatial), jnp.asarray(goal), temperature=0.1)
+    rows, cols = heatmap_argmax(heat)
+    assert (int(rows[0]), int(cols[0])) == (2, 4)
+    assert (int(rows[1]), int(cols[1])) == (4, 0)
+    np.testing.assert_allclose(np.asarray(heat.sum(axis=(1, 2))), 1.0,
+                               rtol=1e-5)
+
+
+class TestGrasp2VecEndToEnd:
+
+  @pytest.fixture(scope="class")
+  def run(self, tmp_path_factory):
+    """collect → train → checkpoint, shared across asserts."""
+    root = tmp_path_factory.mktemp("g2v_e2e")
+    data_path = collect_grasp_triplets(
+        str(root / "train.tfrecord"), num_episodes=192, image_size=IMG,
+        num_object_types=NUM_TYPES, num_distractors=1, seed=0)
+    model = tiny_model()
+    model_dir = str(root / "model")
+    train_eval.train_eval_model(
+        model=model,
+        model_dir=model_dir,
+        input_generator_train=TFRecordInputGenerator(
+            file_patterns=data_path, shuffle_buffer_size=192, seed=1),
+        input_generator_eval=TFRecordInputGenerator(
+            file_patterns=data_path, shuffle=False, repeat=False),
+        max_train_steps=120,
+        eval_steps=2,
+        batch_size=16,
+        save_checkpoints_steps=120,
+        log_every_steps=20,
+    )
+    return model, model_dir
+
+  def test_loss_decreases(self, run):
+    _, model_dir = run
+    records = [json.loads(line) for line in
+               open(os.path.join(model_dir, "metrics_train.jsonl"))]
+    assert records[-1]["loss"] < records[0]["loss"]
+
+  def test_in_batch_retrieval_learns(self, run):
+    _, model_dir = run
+    records = [json.loads(line) for line in
+               open(os.path.join(model_dir, "metrics_train.jsonl"))]
+    # Chance is ~1/16 plus duplicate mass; learned should be decisive.
+    assert records[-1]["retrieval_top1"] > 0.5
+
+  def test_gallery_retrieval_through_predictor(self, run):
+    model, model_dir = run
+    predictor = CheckpointPredictor(model, checkpoint_dir=model_dir)
+    assert predictor.restore(timeout_secs=0)
+    metrics = evaluate_retrieval(
+        predictor.predict, num_queries=32, image_size=IMG,
+        num_object_types=NUM_TYPES, num_distractors=1, seed=9)
+    assert metrics["chance_top1"] == pytest.approx(1.0 / NUM_TYPES)
+    # Decisively above chance (0.25): embedding arithmetic must have
+    # isolated the removed object, not the scene background.
+    assert metrics["retrieval_top1"] >= 0.6
+
+  def test_matched_goal_outscores_mismatched(self, run):
+    model, model_dir = run
+    predictor = CheckpointPredictor(model, checkpoint_dir=model_dir)
+    assert predictor.restore(timeout_secs=0)
+    gen = GraspSceneGenerator(image_size=IMG,
+                              num_object_types=NUM_TYPES,
+                              num_distractors=1, seed=11)
+    triplets = [gen.sample() for _ in range(16)]
+    batch = {k: np.stack([t[k] for t in triplets])
+             for k in ("pregrasp_image", "postgrasp_image",
+                       "goal_image")}
+    out = predictor.predict(batch)
+    matched = np.asarray(out[GOAL_REWARD])
+    # Mismatched: pair each scene with the NEXT query's goal image.
+    batch["goal_image"] = np.roll(batch["goal_image"], 1, axis=0)
+    ids = np.array([int(t["object_id"]) for t in triplets])
+    keep = ids != np.roll(ids, 1)  # only truly different objects
+    mismatched = np.asarray(predictor.predict(batch)[GOAL_REWARD])
+    assert matched.mean() > mismatched[keep].mean() + 0.2
+
+  def test_predict_outputs_complete(self, run):
+    model, model_dir = run
+    predictor = CheckpointPredictor(model, checkpoint_dir=model_dir)
+    assert predictor.restore(timeout_secs=0)
+    gen = GraspSceneGenerator(image_size=IMG,
+                              num_object_types=NUM_TYPES, seed=5)
+    t = gen.sample()
+    out = predictor.predict(
+        {k: t[k][None] for k in ("pregrasp_image", "postgrasp_image",
+                                 "goal_image")})
+    for key in (PREGRASP_EMBEDDING, POSTGRASP_EMBEDDING, GOAL_EMBEDDING,
+                GOAL_REWARD, SCENE_SPATIAL):
+      assert key in out and np.isfinite(np.asarray(out[key])).all()
+    assert np.asarray(out[SCENE_SPATIAL]).ndim == 4
+
+
+class TestShippedConfig:
+
+  def test_config_parses_and_builds_model(self):
+    from tensor2robot_tpu import config as gin
+    import tensor2robot_tpu.train_eval  # noqa: F401 registers
+    import tensor2robot_tpu.research.grasp2vec  # noqa: F401
+    import tensor2robot_tpu.data  # noqa: F401
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tensor2robot_tpu", "research", "grasp2vec", "configs",
+        "train_grasp2vec.gin")
+    gin.clear_config()
+    try:
+      gin.parse_config_files_and_bindings([path], [])
+      model = gin.query_parameter("train_eval_model.model").resolve()
+      assert model.get_feature_specification(Mode.TRAIN) is not None
+      assert model.embedding_size == 128
+    finally:
+      gin.clear_config()
