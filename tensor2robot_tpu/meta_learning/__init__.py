@@ -2,9 +2,11 @@
 
 from tensor2robot_tpu.meta_learning.maml_model import (
     CONDITION,
+    CONDITION_LABELS,
     INFERENCE,
     MAMLModel,
 )
+from tensor2robot_tpu.meta_learning.meta_policies import MetaPolicy
 from tensor2robot_tpu.meta_learning.meta_data import (
     EpisodeMetaInputGenerator,
     MetaExampleInputGenerator,

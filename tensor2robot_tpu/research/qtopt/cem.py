@@ -85,8 +85,13 @@ def cem_maximize(
   init = (mean, std,
           jnp.zeros((batch_size, action_dim)),
           jnp.full((batch_size,), -jnp.inf))
+  # unroll=True: 2-3 iterations, so full unrolling costs nothing in
+  # compile time, removes loop overhead, and keeps XLA cost analysis
+  # honest (it counts a rolled while-body ONCE regardless of trip
+  # count, which silently under-reports FLOPs/MFU in benchmarks).
   (mean, std, best_action, best_score), _ = jax.lax.scan(
-      one_iteration, init, jax.random.split(rng, iterations))
+      one_iteration, init, jax.random.split(rng, iterations),
+      unroll=True)
   return CEMResult(best_action, best_score, mean, std)
 
 
@@ -144,6 +149,20 @@ def make_encoded_q_score_fn(
   image = flat_state.pop("image")
   encoded = network.apply(variables, image, train=False,
                           method="encode")
+
+  if hasattr(network, "score_population"):
+    # Linearity-split population scoring: no tiled torso-map
+    # materialization at all (see GraspingQNetwork.score_population).
+    # A stale "action" in the state features would become an extra
+    # input; the tiled path overrides it with the candidates, so drop
+    # it here for the same semantics.
+    extras = {k: v for k, v in flat_state.items() if k != "action"}
+
+    def population_score_fn(actions: jax.Array) -> jax.Array:
+      return network.apply(variables, encoded, extras, actions,
+                           method="score_population")
+
+    return population_score_fn
 
   def score_fn(actions: jax.Array) -> jax.Array:
     b, p, a = actions.shape

@@ -1,0 +1,228 @@
+"""Export-and-adapt through serving: the meta-model robot handoff.
+
+VERDICT r2 item 4 / SURVEY §3 `meta_learning/meta_policies.py`: a
+trained MAML model and a trained SNAIL model are exported to SavedModel
+via jax2tf and driven through `SavedModelPredictor` + `MetaPolicy` with
+demonstration conditioning. The bar is behavioral: adapted predictions
+must measurably beat unadapted / wrong-demonstration ones THROUGH THE
+EXPORTED ARTIFACT, not just through the python model class.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from tensor2robot_tpu.data.abstract_input_generator import Mode
+from tensor2robot_tpu.export import SavedModelExportGenerator
+from tensor2robot_tpu.meta_learning import MAMLModel, MetaPolicy
+from tensor2robot_tpu.models import optimizers as opt_lib
+from tensor2robot_tpu.predictors import (
+    CheckpointPredictor,
+    SavedModelPredictor,
+)
+from tensor2robot_tpu.specs import (
+    ExtendedTensorSpec,
+    TensorSpecStruct,
+)
+from tensor2robot_tpu.utils.mocks import MockT2RModel
+
+N_COND, N_INF = 8, 8
+
+
+class SineModel(MockT2RModel):
+  """Scalar regression base: x -> a*sin(x + phase), per-task (a, phase)."""
+
+  def get_feature_specification(self, mode):
+    st = TensorSpecStruct()
+    st.x = ExtendedTensorSpec(shape=(1,), dtype=np.float32, name="x")
+    return st
+
+  def get_label_specification(self, mode):
+    st = TensorSpecStruct()
+    st.target = ExtendedTensorSpec(shape=(1,), dtype=np.float32,
+                                   name="target")
+    return st
+
+
+def _sample_sine_tasks(rng, num_tasks, n):
+  phases = rng.uniform(0, np.pi, (num_tasks, 1, 1))
+  amps = rng.uniform(0.5, 2.0, (num_tasks, 1, 1))
+  x = rng.uniform(-np.pi, np.pi, (num_tasks, n, 1)).astype(np.float32)
+  y = (amps * np.sin(x + phases)).astype(np.float32)
+  return x, y, phases, amps
+
+
+@pytest.fixture(scope="module")
+def trained_maml(tmp_path_factory):
+  """Meta-trains the sine MAML and exports it to SavedModel."""
+  model = MAMLModel(
+      base_model=SineModel(output_size=1, hidden_sizes=(32, 32)),
+      num_inner_steps=3, inner_lr=0.1,
+      num_condition_samples_per_task=N_COND,
+      num_inference_samples_per_task=N_INF,
+      create_optimizer_fn=lambda: opt_lib.create_optimizer(
+          optimizer_name="adam", learning_rate=1e-3),
+  )
+  state = model.create_train_state(jax.random.PRNGKey(0))
+  train_step = jax.jit(model.train_step)
+  rng = np.random.default_rng(0)
+  for i in range(200):
+    x, y, _, _ = _sample_sine_tasks(rng, 16, N_COND + N_INF)
+    feats = TensorSpecStruct.from_flat_dict({
+        "condition/x": x[:, :N_COND], "inference/x": x[:, N_COND:]})
+    labels = TensorSpecStruct.from_flat_dict({
+        "condition/target": y[:, :N_COND],
+        "inference/target": y[:, N_COND:]})
+    state, _ = train_step(state, feats, labels, jax.random.PRNGKey(i))
+
+  model_dir = str(tmp_path_factory.mktemp("maml_export"))
+  export_dir = SavedModelExportGenerator().export(
+      model, jax.device_get(state), model_dir)
+  return model, state, model_dir, export_dir
+
+
+def _task_error(policy, rng, with_demos, wrong_demos=False):
+  """Mean |prediction − truth| over fresh tasks through the policy."""
+  errors = []
+  for _ in range(8):
+    x, y, phase, amp = _sample_sine_tasks(rng, 1, N_COND + 1)
+    demo_x, demo_y = x[0, :N_COND], y[0, :N_COND]
+    query_x, query_y = x[0, -1], y[0, -1]
+    if with_demos:
+      if wrong_demos:
+        # Anti-task: same inputs, labels from the phase-shifted task.
+        demo_y = (amp[0] * np.sin(demo_x + phase[0] + np.pi)
+                  ).astype(np.float32)
+      policy.set_task({"x": demo_x}, {"target": demo_y})
+    else:
+      policy.reset_task()
+    out = policy.predict({"x": query_x})
+    prediction = np.asarray(
+        out.get("inference_output", next(iter(out.values()))))
+    errors.append(float(np.abs(prediction.reshape(-1)[0]
+                               - query_y[0])))
+  return float(np.mean(errors))
+
+
+class TestMAMLThroughSavedModel:
+
+  def test_policy_infers_meta_layout(self, trained_maml):
+    _, _, _, export_dir = trained_maml
+    predictor = SavedModelPredictor(export_dir + "/..")
+    # export() returns the timestamped dir; the predictor polls the base.
+    predictor = SavedModelPredictor(
+        export_dir.rsplit("/", 1)[0])
+    assert predictor.restore(timeout_secs=0)
+    policy = MetaPolicy(predictor)
+    assert policy.num_condition == N_COND
+    assert policy.num_inference == N_INF
+
+  def test_adapted_beats_wrong_demos_through_export(self, trained_maml):
+    _, _, _, export_dir = trained_maml
+    predictor = SavedModelPredictor(export_dir.rsplit("/", 1)[0])
+    assert predictor.restore(timeout_secs=0)
+    policy = MetaPolicy(predictor)
+    adapted = _task_error(policy, np.random.default_rng(7),
+                          with_demos=True)
+    anti = _task_error(policy, np.random.default_rng(7),
+                       with_demos=True, wrong_demos=True)
+    # Conditioning on the true task's demonstrations must matter
+    # through the exported artifact: the anti-task demos steer the
+    # adapted model the wrong way.
+    assert adapted < anti * 0.7, (adapted, anti)
+
+  def test_adapted_beats_zero_shot_through_checkpoint(self,
+                                                      trained_maml):
+    model, state, model_dir, _ = trained_maml
+    predictor = CheckpointPredictor(model, checkpoint_dir=model_dir)
+    predictor._state = jax.device_get(state)  # serve in-memory state
+    predictor._restored_step = int(np.asarray(state.step))
+    policy = MetaPolicy(predictor)
+    adapted = _task_error(policy, np.random.default_rng(3),
+                          with_demos=True)
+    zero_shot = _task_error(policy, np.random.default_rng(3),
+                            with_demos=False)
+    assert adapted < zero_shot * 0.8, (adapted, zero_shot)
+
+
+class TestSNAILThroughSavedModel:
+
+  @pytest.fixture(scope="class")
+  def trained_snail(self, tmp_path_factory):
+    """Trains the vrgripper SNAIL on copy-the-demo-action tasks.
+
+    Task structure: every step of a task shares one constant action
+    (the task id in disguise), observable ONLY through the
+    demonstration actions — pure in-context conditioning.
+    """
+    from tensor2robot_tpu.research.vrgripper import (
+        VRGripperSNAILModel,
+    )
+
+    nc = ni = 4
+    model = VRGripperSNAILModel(
+        image_size=16, filters=(8,), embedding_size=16,
+        snail_filters=16, num_condition_samples_per_task=nc,
+        num_inference_samples_per_task=ni,
+        create_optimizer_fn=lambda: opt_lib.create_optimizer(
+            optimizer_name="adam", learning_rate=2e-3),
+    )
+    state = model.create_train_state(jax.random.PRNGKey(0))
+    train_step = jax.jit(model.train_step)
+    rng = np.random.default_rng(0)
+
+    def meta_batch(num_tasks=8):
+      action = rng.uniform(-1, 1, (num_tasks, 1, 3)).astype(np.float32)
+      def obs(n):
+        return {
+            "image": rng.integers(
+                0, 255, (num_tasks, n, 16, 16, 3)).astype(np.uint8),
+            "gripper_pose": rng.normal(
+                size=(num_tasks, n, 3)).astype(np.float32),
+        }
+      cond, inf = obs(nc), obs(ni)
+      feats = TensorSpecStruct.from_flat_dict({
+          **{f"condition/{k}": v for k, v in cond.items()},
+          **{f"inference/{k}": v for k, v in inf.items()}})
+      labels = TensorSpecStruct.from_flat_dict({
+          "condition/action": np.tile(action, (1, nc, 1)),
+          "inference/action": np.tile(action, (1, ni, 1))})
+      return feats, labels
+
+    for i in range(120):
+      feats, labels = meta_batch()
+      state, metrics = train_step(state, feats, labels,
+                                  jax.random.PRNGKey(i))
+    model_dir = str(tmp_path_factory.mktemp("snail_export"))
+    export_dir = SavedModelExportGenerator(
+        include_tf_example_signature=False).export(
+            model, jax.device_get(state), model_dir)
+    return model, export_dir
+
+  def test_demo_actions_condition_exported_model(self, trained_snail):
+    _, export_dir = trained_snail
+    predictor = SavedModelPredictor(export_dir.rsplit("/", 1)[0])
+    assert predictor.restore(timeout_secs=0)
+    policy = MetaPolicy(predictor)
+
+    rng = np.random.default_rng(5)
+    obs = {
+        "image": rng.integers(0, 255, (16, 16, 3)).astype(np.uint8),
+        "gripper_pose": rng.normal(size=(3,)).astype(np.float32),
+    }
+    demo_obs = {
+        "image": rng.integers(0, 255, (4, 16, 16, 3)).astype(np.uint8),
+        "gripper_pose": rng.normal(size=(4, 3)).astype(np.float32),
+    }
+    errors = []
+    for target in (np.float32([0.8, -0.5, 0.3]),
+                   np.float32([-0.7, 0.6, -0.2])):
+      demos = np.tile(target[None], (4, 1))
+      policy.set_task(demo_obs, {"action": demos})
+      out = policy.predict(obs)
+      prediction = np.asarray(out["action"]).reshape(-1)
+      errors.append(float(np.abs(prediction - target).mean()))
+    # The exported SNAIL must track whichever demonstration actions it
+    # is conditioned on — the same observation maps to both targets.
+    assert max(errors) < 0.25, errors

@@ -106,6 +106,61 @@ class TestGraspingQModel:
     assert np.isfinite(float(metrics["loss"]))
 
 
+class TestScorePopulation:
+  """The linearity-split CEM scoring must match the tiled-head path."""
+
+  @pytest.mark.parametrize("use_batch_norm", [True, False])
+  def test_matches_tiled_head(self, use_batch_norm):
+    from tensor2robot_tpu.research.qtopt import cem
+    from tensor2robot_tpu.models.critic_model import Q_VALUE
+
+    model = GraspingQModel(use_batch_norm=use_batch_norm)
+    net = model.network
+    feats = make_random_tensors(
+        model.get_feature_specification(Mode.TRAIN), batch_size=3,
+        seed=0)
+    feats = jax.tree_util.tree_map(jnp.asarray, feats)
+    variables = model.create_inference_state(
+        RNG, batch_size=3).variables
+    flat = dict(feats.to_flat_dict())
+    image = flat.pop("image")
+    flat.pop("action")
+    actions = jax.random.uniform(jax.random.PRNGKey(1), (3, 5, 4),
+                                 minval=-1.0, maxval=1.0)
+
+    encoded = net.apply(variables, image, train=False, method="encode")
+    q_pop = net.apply(variables, encoded, flat, actions,
+                      method="score_population")
+    tiled = cem.make_q_score_fn(
+        net.apply, variables,
+        TensorSpecStruct.from_flat_dict(
+            {**flat, "image": image, "action": jnp.zeros((3, 4))}),
+        q_key=Q_VALUE)
+    q_ref = tiled(actions)
+    # Exact up to bf16 reassociation of the linear split.
+    np.testing.assert_allclose(np.asarray(q_pop), np.asarray(q_ref),
+                               atol=5e-3)
+
+  def test_learner_uses_population_path(self):
+    """make_encoded_q_score_fn must pick score_population when present."""
+    from tensor2robot_tpu.research.qtopt import cem
+    from tensor2robot_tpu.models.critic_model import Q_VALUE
+
+    model = GraspingQModel()
+    feats = make_random_tensors(
+        model.get_feature_specification(Mode.TRAIN), batch_size=2,
+        seed=0)
+    feats = jax.tree_util.tree_map(jnp.asarray, feats)
+    variables = model.create_inference_state(
+        RNG, batch_size=2).variables
+    score_fn = cem.make_encoded_q_score_fn(
+        model.network, variables, feats, q_key=Q_VALUE)
+    assert score_fn.__name__ == "population_score_fn"
+    scores = score_fn(jnp.zeros((2, 6, 4)))
+    assert scores.shape == (2, 6)
+    assert np.isfinite(np.asarray(scores)).all()
+
+
 class TestReplayBuffer:
 
   def _spec(self):
