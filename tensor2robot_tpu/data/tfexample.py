@@ -146,6 +146,157 @@ def _pad_or_truncate(
   return dense.reshape(target).astype(spec.dtype)
 
 
+def _graph_dtype(tf, spec):
+  name = ("bfloat16" if str(spec.dtype) == "bfloat16"
+          else np.dtype(spec.dtype).name)
+  return getattr(tf, name)
+
+
+def _graph_decode_image(tf, encoded, spec):
+  """Decodes a [N] string tensor of encoded frames inside the TF graph.
+
+  Empty strings (SequenceExample padding) decode to zeros, matching the
+  eager parser's zero-padded frames.
+  """
+  height, width, channels = spec.shape[-3], spec.shape[-2], spec.shape[-1]
+
+  def decode_one(data):
+    def real():
+      image = tf.io.decode_image(data, channels=channels,
+                                 expand_animations=False)
+      return tf.reshape(image, [height, width, channels])
+    return tf.cond(
+        tf.strings.length(data) > 0, real,
+        lambda: tf.zeros([height, width, channels], tf.uint8))
+
+  return tf.map_fn(decode_one, encoded, fn_output_signature=tf.uint8)
+
+
+def graph_parse_example(serialized, feature_spec) -> Dict[str, Any]:
+  """Parses a [B] string tensor of tf.Examples ENTIRELY in TF graph ops.
+
+  The graph twin of `parse_example_batch`: same spec contract (image
+  decode, varlen pad/truncate, static shapes), but traceable — so
+  `dataset.map(parse_fn, num_parallel_calls=AUTOTUNE)` runs parse AND
+  image decode in tf.data's parallel threadpool (the reference's
+  hot-loop shape, SURVEY.md §4.3) instead of single-threaded eager
+  python. Also the body of the exported `parse_tf_example` signature,
+  keeping training-side and serving-side parsers one implementation.
+  """
+  tf = _tf()
+  flat = specs.flatten_spec_structure(feature_spec).to_flat_dict()
+  feature_map = build_feature_map(feature_spec)
+  parsed = tf.io.parse_example(serialized, feature_map)
+  out: Dict[str, Any] = {}
+  for key, spec in flat.items():
+    name = wire_key(key, spec)
+    value = parsed[name]
+    if spec.is_image:
+      images = _graph_decode_image(tf, value, spec)
+      out[key] = tf.cast(images, _graph_dtype(tf, spec))
+      continue
+    if isinstance(value, tf.sparse.SparseTensor):
+      value = tf.sparse.to_dense(value)
+    if spec.varlen:
+      # Parity with the eager parser's _pad_or_truncate: ragged wire
+      # data is zero-padded / truncated to the declared static length.
+      flat_len = int(np.prod(spec.shape))
+      value = tf.reshape(value, [tf.shape(value)[0], -1])
+      cur = tf.shape(value)[1]
+      value = tf.cond(
+          cur < flat_len,
+          lambda: tf.pad(value, [[0, 0], [0, flat_len - cur]]),
+          lambda: value[:, :flat_len])
+    value = tf.reshape(value, [-1] + list(spec.shape))
+    out[key] = tf.cast(value, _graph_dtype(tf, spec))
+  return out
+
+
+def graph_parse_sequence_example(serialized, feature_spec,
+                                 sequence_length: int) -> Dict[str, Any]:
+  """Graph twin of `parse_sequence_example_batch` (same contract).
+
+  Sequence keys come back [B, sequence_length, ...] zero-padded /
+  truncated, context keys [B, ...], true pre-pad lengths (clipped)
+  under SEQUENCE_LENGTH_KEY — all as TF ops, so episode pipelines
+  (per-frame image decode included) parallelize under tf.data.
+  """
+  tf = _tf()
+  flat = specs.flatten_spec_structure(feature_spec).to_flat_dict()
+  if SEQUENCE_LENGTH_KEY in flat:
+    raise ValueError(
+        f"Spec key {SEQUENCE_LENGTH_KEY!r} is reserved: the parser "
+        f"emits the true episode lengths under it. Rename the feature.")
+  context_map, sequence_map = build_sequence_feature_maps(feature_spec)
+  context, parsed_seq, seq_lengths = tf.io.parse_sequence_example(
+      serialized, context_features=context_map or None,
+      sequence_features=sequence_map)
+  batch = tf.shape(serialized)[0]
+
+  def fit_time(value):
+    """Pads/truncates the time axis (axis 1) to sequence_length."""
+    t = tf.shape(value)[1]
+    value = value[:, :sequence_length]
+    pad = [[0, 0], [0, tf.maximum(0, sequence_length - t)]] + \
+        [[0, 0]] * (value.shape.ndims - 2)
+    return tf.pad(value, pad)
+
+  out: Dict[str, Any] = {}
+  true_lengths = tf.zeros([batch], tf.int32)
+  for key, spec in flat.items():
+    name = wire_key(key, spec)
+    if not spec.is_sequence:
+      value = context[name]
+      if isinstance(value, tf.sparse.SparseTensor):
+        value = tf.sparse.to_dense(value)
+      if spec.is_image:
+        out[key] = tf.cast(
+            _graph_decode_image(tf, value, spec),
+            _graph_dtype(tf, spec))
+      elif spec.varlen:
+        flat_len = int(np.prod(spec.shape))
+        value = tf.reshape(value, [batch, -1])
+        cur = tf.shape(value)[1]
+        value = tf.cond(
+            cur < flat_len,
+            lambda: tf.pad(value, [[0, 0], [0, flat_len - cur]]),
+            lambda: value[:, :flat_len])
+        out[key] = tf.cast(
+            tf.reshape(value, [-1] + list(spec.shape)),
+            _graph_dtype(tf, spec))
+      else:
+        out[key] = tf.cast(
+            tf.reshape(value, [-1] + list(spec.shape)),
+            _graph_dtype(tf, spec))
+      continue
+
+    value = parsed_seq[name]
+    if isinstance(value, tf.RaggedTensor):
+      value = value.to_tensor()
+    if isinstance(value, tf.sparse.SparseTensor):
+      value = tf.sparse.to_dense(value)
+    lengths = tf.cast(tf.reshape(seq_lengths[name], [batch]), tf.int32)
+    true_lengths = tf.maximum(
+        true_lengths, tf.minimum(lengths, sequence_length))
+    if spec.is_image:
+      # [B, T] encoded strings -> pad/trunc T -> decode all frames in
+      # one flattened map_fn ("" pads decode to zero frames).
+      frames = fit_time(value)
+      flat_frames = tf.reshape(frames, [-1])
+      decoded = _graph_decode_image(tf, flat_frames, spec)
+      decoded = tf.reshape(
+          decoded, [-1, sequence_length] + list(spec.shape))
+      out[key] = tf.cast(decoded, _graph_dtype(tf, spec))
+      continue
+    dense = fit_time(value)  # [B, T, prod(shape)]
+    out[key] = tf.cast(
+        tf.reshape(dense, [-1, sequence_length] + list(spec.shape)),
+        _graph_dtype(tf, spec))
+
+  out[SEQUENCE_LENGTH_KEY] = true_lengths
+  return out
+
+
 def _encode_feature(value: Any, spec: ExtendedTensorSpec) -> Any:
   """Encodes ONE unbatched value as a tf.train.Feature per its spec."""
   tf = _tf()

@@ -71,8 +71,16 @@ class TFRecordInputGenerator(AbstractInputGenerator):
           f"No TFRecord files matched patterns: {self._file_patterns}")
     return files
 
-  def _serialized_batches(self, mode: Mode, batch_size: int):
-    """tf.data pipeline over raw serialized records (shared plumbing)."""
+  def _batched_dataset(self, mode: Mode, batch_size: int,
+                       parse_fn=None):
+    """tf.data pipeline over raw serialized records (shared plumbing).
+
+    With `parse_fn` (a traceable [B] strings → dict-of-tensors fn, see
+    tfexample.graph_parse_example), parsing AND image decode run INSIDE
+    the dataset graph under `map(num_parallel_calls=AUTOTUNE)` — the
+    reference's hot-loop shape (SURVEY.md §4.3). Eager per-batch python
+    decode cannot feed a chip at production step rates.
+    """
     import tensorflow as tf  # lazy, host-side only
 
     files = self._file_list()
@@ -88,8 +96,14 @@ class TFRecordInputGenerator(AbstractInputGenerator):
     if self._shuffle and mode == Mode.TRAIN:
       ds = ds.shuffle(self._shuffle_buffer_size, seed=self._seed)
     ds = ds.batch(batch_size, drop_remainder=True)
+    if parse_fn is not None:
+      ds = ds.map(parse_fn, num_parallel_calls=tf.data.AUTOTUNE)
     ds = ds.prefetch(tf.data.AUTOTUNE)
     return ds.as_numpy_iterator()
+
+  def _serialized_batches(self, mode: Mode, batch_size: int):
+    """Unparsed [B]-string batches (tests / custom parsers)."""
+    return self._batched_dataset(mode, batch_size, parse_fn=None)
 
   def _merged_spec(self):
     """Feature+label specs merged for a single parse per batch.
@@ -121,9 +135,12 @@ class TFRecordInputGenerator(AbstractInputGenerator):
       self, mode: Mode, batch_size: int,
   ) -> Iterator[Tuple[TensorSpecStruct, Optional[TensorSpecStruct]]]:
     merged_struct, feature_keys, label_keys = self._merged_spec()
-    for serialized in self._serialized_batches(mode, batch_size):
-      parsed = tfexample.parse_example_batch(serialized, merged_struct)
-      yield self._split_parsed(parsed, feature_keys, label_keys)
+    parse_fn = lambda serialized: tfexample.graph_parse_example(  # noqa: E731
+        serialized, merged_struct)
+    for flat in self._batched_dataset(mode, batch_size, parse_fn):
+      yield self._split_parsed(
+          TensorSpecStruct.from_flat_dict(dict(flat)),
+          feature_keys, label_keys)
 
 
 # Reference-compatible alias.
@@ -161,11 +178,12 @@ class TFRecordEpisodeInputGenerator(TFRecordInputGenerator):
     # the lengths is just not listing them.
     extra = ((tfexample.SEQUENCE_LENGTH_KEY,)
              if self._include_sequence_length else ())
-    for serialized in self._serialized_batches(mode, batch_size):
-      parsed = tfexample.parse_sequence_example_batch(
-          serialized, merged_struct, self._sequence_length)
-      yield self._split_parsed(parsed, feature_keys, label_keys,
-                               extra_feature_keys=extra)
+    parse_fn = lambda s: tfexample.graph_parse_sequence_example(  # noqa: E731
+        s, merged_struct, self._sequence_length)
+    for flat in self._batched_dataset(mode, batch_size, parse_fn):
+      yield self._split_parsed(
+          TensorSpecStruct.from_flat_dict(dict(flat)),
+          feature_keys, label_keys, extra_feature_keys=extra)
 
 
 def write_tfrecord(

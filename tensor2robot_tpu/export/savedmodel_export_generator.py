@@ -111,37 +111,14 @@ class SavedModelExportGenerator(AbstractExportGenerator):
     signatures = {"serving_default": serving_default}
 
     if self._include_tf_example_signature:
-      feature_map = tfexample.build_feature_map(feature_spec)
 
       @tf.function(input_signature=[
           tf.TensorSpec([batch_dim], tf.string, name="examples")])
       def parse_tf_example(serialized):
-        parsed = tf.io.parse_example(serialized, feature_map)
-        flat = {}
-        for key, spec in flat_specs.items():
-          wire = tfexample.wire_key(key, spec)
-          value = parsed[wire]
-          if isinstance(value, tf.sparse.SparseTensor):
-            value = tf.sparse.to_dense(value)
-          if spec.is_image and value.dtype == tf.string:
-            value = tf.map_fn(
-                lambda b: tf.io.decode_image(
-                    b, channels=spec.shape[-1], expand_animations=False),
-                value, fn_output_signature=tf.uint8)
-          if spec.varlen:
-            # Parity with the training parser's _pad_or_truncate: a
-            # ragged feature is zero-padded / truncated to the declared
-            # length, never rejected.
-            flat_len = int(np.prod(spec.shape))
-            value = tf.reshape(value, [tf.shape(value)[0], -1])
-            cur = tf.shape(value)[1]
-            value = tf.cond(
-                cur < flat_len,
-                lambda: tf.pad(value, [[0, 0], [0, flat_len - cur]]),
-                lambda: value[:, :flat_len])
-          value = tf.reshape(
-              value, [-1] + list(spec.shape))
-          flat[key] = tf.cast(value, _tf_dtype(tf, spec))
+        # Same graph parser the training-side tf.data pipeline maps —
+        # ONE implementation of the wire contract (decode, varlen
+        # pad/truncate, static shapes) for train and serve.
+        flat = tfexample.graph_parse_example(serialized, feature_spec)
         return converted(flat)
 
       signatures["parse_tf_example"] = parse_tf_example

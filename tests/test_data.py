@@ -159,6 +159,128 @@ def make_episode(rng, t):
   }
 
 
+class TestGraphParsers:
+  """The tf.data-graph parsers must match the eager parsers exactly.
+
+  These are the production path (parse + image decode inside
+  `dataset.map(num_parallel_calls=AUTOTUNE)`, SURVEY §4.3) and the body
+  of the exported parse_tf_example signature; the eager parsers are the
+  contract they are tested against.
+  """
+
+  def _example_batch(self):
+    fs = feature_spec()
+    rng = np.random.default_rng(0)
+    yy, xx = np.mgrid[0:12, 0:10]
+    examples = []
+    for i in range(3):
+      examples.append({
+          "image": np.stack([yy * 2 * (i + 1), xx * 3, (yy + xx) * i],
+                            axis=-1).astype(np.uint8),
+          "pose": rng.standard_normal(6).astype(np.float32),
+          "count": np.array([i], np.int64),
+      })
+    serialized = np.array(
+        [tfexample.encode_example(e, fs) for e in examples],
+        dtype=object)
+    return fs, serialized
+
+  def test_example_graph_matches_eager(self):
+    import tensorflow as tf
+    fs, serialized = self._example_batch()
+    eager = tfexample.parse_example_batch(serialized, fs)
+    graph = tf.function(
+        lambda s: tfexample.graph_parse_example(s, fs))(
+            tf.convert_to_tensor(serialized))
+    for key, value in eager.to_flat_dict().items():
+      got = np.asarray(graph[key])
+      assert got.dtype == value.dtype, key
+      np.testing.assert_array_equal(got, value, err_msg=key)
+
+  def test_example_graph_varlen(self):
+    import tensorflow as tf
+    st = TensorSpecStruct()
+    st.x = ExtendedTensorSpec(shape=(4,), dtype=np.float32, name="x",
+                              varlen=True)
+    short = tf.train.Example(features=tf.train.Features(feature={
+        "x": tf.train.Feature(float_list=tf.train.FloatList(
+            value=[1.0, 2.0]))})).SerializeToString()
+    long = tf.train.Example(features=tf.train.Features(feature={
+        "x": tf.train.Feature(float_list=tf.train.FloatList(
+            value=[1, 2, 3, 4, 5, 6]))})).SerializeToString()
+    graph = tf.function(
+        lambda s: tfexample.graph_parse_example(s, st))(
+            tf.convert_to_tensor(np.array([short, long])))
+    np.testing.assert_array_equal(np.asarray(graph["x"]),
+                                  [[1, 2, 0, 0], [1, 2, 3, 4]])
+
+  def test_sequence_graph_matches_eager(self):
+    import tensorflow as tf
+    st = TensorSpecStruct()
+    st.frames = ExtendedTensorSpec(
+        shape=(6, 5, 3), dtype=np.uint8, name="frames",
+        data_format="png", is_sequence=True)
+    st.action = ExtendedTensorSpec(
+        shape=(2,), dtype=np.float32, name="act", is_sequence=True)
+    st.task_id = ExtendedTensorSpec(shape=(1,), dtype=np.int64,
+                                    name="task")
+    rng = np.random.default_rng(2)
+    episodes = []
+    for t in (2, 5):  # ragged: one under, one over sequence_length=4
+      episodes.append({
+          "frames": rng.integers(0, 255, (t, 6, 5, 3)).astype(np.uint8),
+          "action": rng.standard_normal((t, 2)).astype(np.float32),
+          "task_id": np.array([t], np.int64),
+      })
+    serialized = np.array([
+        tfexample.encode_sequence_example(e, st) for e in episodes],
+        dtype=object)
+    eager = tfexample.parse_sequence_example_batch(serialized, st, 4)
+    graph = tf.function(
+        lambda s: tfexample.graph_parse_sequence_example(s, st, 4))(
+            tf.convert_to_tensor(serialized))
+    for key, value in eager.to_flat_dict().items():
+      got = np.asarray(graph[key])
+      assert got.shape == value.shape, key
+      np.testing.assert_array_equal(got, value, err_msg=key)
+    np.testing.assert_array_equal(
+        np.asarray(graph[tfexample.SEQUENCE_LENGTH_KEY]), [2, 4])
+
+  def test_pipeline_feeds_faster_than_chip(self, tmp_path):
+    """Throughput microbench: host pipeline vs the measured step rate.
+
+    The bench chip consumes ~232 batches/s at batch 256 (BENCH_DETAIL);
+    a single-host tf.data pipeline can't match a 64-image-per-example
+    rate on shared CI hardware, so the assertion here is a sanity
+    floor — the real number is printed for the record. Run on a
+    production host, the AUTOTUNE-parallel decode path is the one that
+    scales with cores; the old eager path was single-threaded.
+    """
+    import time
+    fs = feature_spec()
+    rng = np.random.default_rng(0)
+    examples = [{
+        "image": rng.integers(0, 255, (12, 10, 3)).astype(np.uint8),
+        "pose": rng.standard_normal(6).astype(np.float32),
+        "count": np.array([1], np.int64),
+        "target": rng.standard_normal(2).astype(np.float32),
+    } for _ in range(256)]
+    path = str(tmp_path / "bench.tfrecord")
+    write_tfrecord(path, examples, fs, label_spec())
+    gen = TFRecordInputGenerator(file_patterns=path, batch_size=64,
+                                 shuffle_buffer_size=256, seed=0)
+    gen.set_specification(fs, label_spec())
+    it = gen.create_dataset(Mode.TRAIN)
+    next(it)  # warm the pipeline
+    n = 30
+    t0 = time.perf_counter()
+    for _ in range(n):
+      next(it)
+    rate = n / (time.perf_counter() - t0)
+    print(f"\npipeline: {rate:.1f} batches/s (batch=64, jpeg decode)")
+    assert rate > 5.0  # sanity floor; single-threaded eager was ~this
+
+
 class TestSequenceExampleCodec:
 
   def test_roundtrip_pads_and_reports_lengths(self):
