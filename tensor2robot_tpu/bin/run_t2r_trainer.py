@@ -30,6 +30,19 @@ flags.DEFINE_multi_string(
 flags.DEFINE_multi_string(
     "import_modules", [],
     "Extra modules to import before parsing (to register configurables).")
+flags.DEFINE_string(
+    "jax_coordinator_address", None,
+    "host:port of process 0 for multi-host training "
+    "(jax.distributed.initialize). On TPU pods leave unset — workers "
+    "auto-discover; --jax_init_distributed still opts in.")
+flags.DEFINE_integer("jax_num_processes", None,
+                     "Total process count for multi-host training.")
+flags.DEFINE_integer("jax_process_id", None,
+                     "This process's index for multi-host training.")
+flags.DEFINE_bool(
+    "jax_init_distributed", False,
+    "Force jax.distributed.initialize() even without an explicit "
+    "coordinator (TPU pod auto-discovery).")
 
 # Configurable registration happens at import; pull in every in-tree
 # family so configs can reference them without import lines.
@@ -41,6 +54,7 @@ _DEFAULT_MODULES = (
     "tensor2robot_tpu.predictors",
     "tensor2robot_tpu.hooks",
     "tensor2robot_tpu.meta_learning",
+    "tensor2robot_tpu.research.grasp2vec",
     "tensor2robot_tpu.research.pose_env",
     "tensor2robot_tpu.research.qtopt",
     "tensor2robot_tpu.research.vrgripper",
@@ -49,6 +63,16 @@ _DEFAULT_MODULES = (
 
 def main(argv):
   del argv
+  # Multi-host wiring comes first: jax.distributed must initialize
+  # before any device use (SURVEY §3 "multi-slice via jax distributed
+  # init"). Single-process runs no-op.
+  from tensor2robot_tpu.parallel import maybe_initialize_distributed
+  maybe_initialize_distributed(
+      coordinator_address=FLAGS.jax_coordinator_address,
+      num_processes=FLAGS.jax_num_processes,
+      process_id=FLAGS.jax_process_id,
+      force=FLAGS.jax_init_distributed,
+  )
   for module in list(_DEFAULT_MODULES) + list(FLAGS.import_modules):
     try:
       importlib.import_module(module)

@@ -10,6 +10,9 @@ from tensor2robot_tpu.parallel.mesh import (
     local_batch_size,
     replicated,
 )
+from tensor2robot_tpu.parallel.distributed import (
+    maybe_initialize_distributed,
+)
 from tensor2robot_tpu.parallel.sharding import (
     fsdp_sharding,
     state_sharding,

@@ -1,0 +1,79 @@
+"""Multi-host (multi-process) JAX runtime initialization.
+
+Reference parity: the reference scaled across hosts with TPUEstimator's
+cluster config (SURVEY.md §3 parallelism table "multi-slice via jax
+distributed init" [U]); the JAX-native equivalent is
+`jax.distributed.initialize`, after which `jax.devices()` spans every
+host's chips and one `Mesh` + GSPMD program covers the whole slice —
+collectives ride ICI within a slice and DCN across slices.
+
+Call `maybe_initialize_distributed()` ONCE at binary startup, before
+any jax device use. On TPU pods the runtime discovers coordinator /
+process_id / process_count from the TPU metadata, so an argless
+initialize is correct; off-pod multi-process runs (CPU/GPU fleets,
+tests) pass the coordination triple explicitly. Single-process runs
+no-op, so the same binary works from a laptop to a v5e-64.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+_INITIALIZED = False
+
+
+def maybe_initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    force: bool = False,
+) -> bool:
+  """Initializes jax.distributed when a multi-process launch is detected.
+
+  Triggers when any of:
+    * explicit args (coordinator_address or force=True),
+    * `JAX_COORDINATOR_ADDRESS` env (+`JAX_NUM_PROCESSES`/
+      `JAX_PROCESS_ID`) — the framework's own launch contract,
+    * a TPU pod environment (`TPU_WORKER_HOSTNAMES` with >1 worker),
+      where the argless auto-discovery path is used.
+
+  Idempotent; returns True when jax.distributed is (now) initialized.
+  """
+  global _INITIALIZED
+  if _INITIALIZED:
+    return True
+
+  coordinator_address = coordinator_address or os.environ.get(
+      "JAX_COORDINATOR_ADDRESS")
+  if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
+    num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+  if process_id is None and "JAX_PROCESS_ID" in os.environ:
+    process_id = int(os.environ["JAX_PROCESS_ID"])
+
+  pod_workers = [w for w in os.environ.get(
+      "TPU_WORKER_HOSTNAMES", "").split(",") if w]
+  on_pod = len(pod_workers) > 1
+
+  if not (coordinator_address or on_pod or force):
+    return False
+
+  import jax
+
+  kwargs = {}
+  if coordinator_address:
+    kwargs["coordinator_address"] = coordinator_address
+  if num_processes is not None:
+    kwargs["num_processes"] = num_processes
+  if process_id is not None:
+    kwargs["process_id"] = process_id
+  jax.distributed.initialize(**kwargs)
+  _INITIALIZED = True
+  log.info(
+      "jax.distributed initialized: process %d/%d, %d local / %d global "
+      "devices.", jax.process_index(), jax.process_count(),
+      jax.local_device_count(), jax.device_count())
+  return True
