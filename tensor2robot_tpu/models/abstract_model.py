@@ -227,6 +227,22 @@ class AbstractT2RModel(ModelInterface):
 
   # ---- steps (pure; the trainer jits these) ----
 
+  def network_inputs_from_labels(self,
+                                 features: TensorSpecStruct,
+                                 labels: Optional[TensorSpecStruct],
+                                 mode: Mode) -> TensorSpecStruct:
+    """Hook: lift label-derived conditioning INPUTS into the features.
+
+    Models whose networks consume parts of the labels as inputs —
+    demonstration actions conditioning WTL/SNAIL policies — override
+    this instead of re-implementing loss_fn. Runs after preprocessing
+    in train/eval; at predict time the same inputs must arrive inside
+    the feature struct directly (the condition_labels serving
+    convention), so this hook is NOT called then. Default: unchanged.
+    """
+    del labels, mode
+    return features
+
   def loss_fn(self, params, batch_stats, features, labels, rng,
               mode: Mode):
     variables = {"params": params}
@@ -236,6 +252,7 @@ class AbstractT2RModel(ModelInterface):
                         else (None, None))
     features, labels = self.preprocessor.preprocess(
         features, labels, mode, rng_pre)
+    features = self.network_inputs_from_labels(features, labels, mode)
     outputs, new_stats = self.inference_network_fn(
         variables, features, mode, rng_net)
     loss, scalars = self.model_train_fn(features, labels, outputs, mode)
@@ -265,6 +282,8 @@ class AbstractT2RModel(ModelInterface):
     variables = state.variables
     features, labels = self.preprocessor.preprocess(
         features, labels, Mode.EVAL, None)
+    features = self.network_inputs_from_labels(features, labels,
+                                               Mode.EVAL)
     outputs, _ = self.inference_network_fn(variables, features, Mode.EVAL)
     return self.model_eval_fn(features, labels, outputs)
 

@@ -26,19 +26,20 @@ import jax.numpy as jnp
 from tensor2robot_tpu import config as gin
 from tensor2robot_tpu.data.abstract_input_generator import Mode
 from tensor2robot_tpu.layers import SNAIL
-from tensor2robot_tpu.layers.mdn import MDNHead, mdn_loss, mdn_mode
+from tensor2robot_tpu.layers.mdn import MDNHead, mdn_mode
 from tensor2robot_tpu.meta_learning import MAMLModel
 from tensor2robot_tpu.meta_learning.maml_model import (
     CONDITION,
     CONDITION_LABELS,
     INFERENCE,
 )
+from tensor2robot_tpu.models.abstract_model import AbstractT2RModel
 from tensor2robot_tpu.models.regression_model import INFERENCE_OUTPUT
 from tensor2robot_tpu.research.vrgripper.vrgripper_models import (
     ACTION,
     GripperObsEncoder,
     VRGripperRegressionModel,
-    mdn_params_from_outputs,
+    action_supervision_loss,
 )
 from tensor2robot_tpu.specs import TensorSpecStruct
 
@@ -208,40 +209,28 @@ class VRGripperSNAILModel(MAMLModel):
         dtype=self._base.device_dtype,
     )
 
-  def _with_demo_actions(self, features, cond_labels) -> TensorSpecStruct:
-    """Injects demonstration actions under condition_labels/…."""
+  def network_inputs_from_labels(self, features, labels, mode):
+    """Demonstration labels condition the trunk: lift every condition
+    label under condition_labels/… (predict-time they arrive there
+    directly — the shared serving convention)."""
+    if labels is None:
+      return features
     flat = features.to_flat_dict()
-    if cond_labels is not None:
-      for key, value in cond_labels.to_flat_dict().items():
-        flat[f"{CONDITION_LABELS}/{key}"] = value
+    for key, value in labels[CONDITION].to_flat_dict().items():
+      flat[f"{CONDITION_LABELS}/{key}"] = value
     return TensorSpecStruct.from_flat_dict(flat)
 
   def loss_fn(self, params, batch_stats, features, labels, rng,
               mode: Mode):
-    if batch_stats:
-      raise ValueError("SNAIL meta policy must be batch-stats free.")
-    train = mode == Mode.TRAIN
-    rng_pre, rng_net = (jax.random.split(rng) if rng is not None
-                        else (None, None))
-    features, labels = self.preprocessor.preprocess(
-        features, labels, mode, rng_pre)
-    cond_l = labels[CONDITION] if labels is not None else None
-    features = self._with_demo_actions(features, cond_l)
-    rngs = {"dropout": rng_net} if (train and rng_net is not None) \
-        else None
-    outputs = self.network.apply({"params": params}, features,
-                                 train=train, rngs=rngs)
-    target = labels[INFERENCE][ACTION].astype(jnp.float32)
-    predicted = outputs[ACTION].astype(jnp.float32)
-    action_error = jnp.mean(jnp.abs(predicted - target))
-    mdn_params = mdn_params_from_outputs(outputs)
-    if mdn_params is not None:
-      loss = mdn_loss(mdn_params, target)
-      metrics = {"nll": loss, "action_error": action_error}
-    else:
-      loss = jnp.mean(jnp.square(predicted - target))
-      metrics = {"mse": loss, "action_error": action_error}
-    return loss, (metrics, batch_stats)
+    # In-context conditioning replaces gradient adaptation: the plain
+    # supervised loss path (with the labels-as-inputs hook) applies,
+    # not MAMLModel's inner-loop loss.
+    return AbstractT2RModel.loss_fn(self, params, batch_stats,
+                                    features, labels, rng, mode)
+
+  def model_train_fn(self, features, labels, outputs, mode
+                     ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    return action_supervision_loss(outputs, labels[INFERENCE][ACTION])
 
   def predict_step(self, state, features) -> Any:
     features, _ = self.preprocessor.preprocess(

@@ -126,6 +126,22 @@ def mdn_params_from_outputs(outputs) -> Optional[MDNParams]:
                    outputs[MDN_LOG_SCALES])
 
 
+def action_supervision_loss(outputs, target
+                            ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+  """(loss, metrics) for action cloning: MDN NLL when the output dict
+  carries mixture params, MSE otherwise. The one action-supervision
+  implementation every gripper policy (BC, WTL, SNAIL) shares."""
+  target = target.astype(jnp.float32)
+  predicted = outputs[ACTION].astype(jnp.float32)
+  action_error = jnp.mean(jnp.abs(predicted - target))
+  params = mdn_params_from_outputs(outputs)
+  if params is not None:
+    loss = mdn_loss(params, target)
+    return loss, {"nll": loss, "action_error": action_error}
+  loss = jnp.mean(jnp.square(predicted - target))
+  return loss, {"mse": loss, "action_error": action_error}
+
+
 @gin.configurable
 class VRGripperRegressionModel(AbstractT2RModel):
   """BC policy: clone expert actions from (image, gripper_pose).
@@ -192,15 +208,7 @@ class VRGripperRegressionModel(AbstractT2RModel):
 
   def model_train_fn(self, features, labels, outputs, mode
                      ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
-    target = labels[ACTION].astype(jnp.float32)
-    predicted = outputs[ACTION].astype(jnp.float32)
-    action_error = jnp.mean(jnp.abs(predicted - target))
-    params = mdn_params_from_outputs(outputs)
-    if params is not None:
-      loss = mdn_loss(params, target)
-      return loss, {"nll": loss, "action_error": action_error}
-    loss = jnp.mean(jnp.square(predicted - target))
-    return loss, {"mse": loss, "action_error": action_error}
+    return action_supervision_loss(outputs, labels[ACTION])
 
   def sample_action(self, state, features, rng: jax.Array) -> jax.Array:
     """Draws a stochastic action (MDN) or returns the mean (MSE)."""

@@ -50,7 +50,7 @@ from tensor2robot_tpu.models.regression_model import INFERENCE_OUTPUT
 from tensor2robot_tpu.research.vrgripper.vrgripper_models import (
     ACTION,
     GripperObsEncoder,
-    mdn_params_from_outputs,
+    action_supervision_loss,
 )
 from tensor2robot_tpu.specs import ExtendedTensorSpec, TensorSpecStruct
 
@@ -253,50 +253,16 @@ class VRGripperWTLModel(AbstractT2RModel):
         dtype=self.device_dtype,
     )
 
-  def loss_fn(self, params, batch_stats, features, labels, rng,
-              mode: Mode):
-    if batch_stats:
-      raise ValueError("WTL policies must be batch-stats free.")
-    train = mode == Mode.TRAIN
-    rng_pre, rng_net = (jax.random.split(rng) if rng is not None
-                        else (None, None))
-    features, labels = self.preprocessor.preprocess(
-        features, labels, mode, rng_pre)
-    # Demo actions are conditioning INPUT: lift them from labels into
-    # the feature struct (predict-time they arrive via
-    # condition_labels directly).
+  def network_inputs_from_labels(self, features, labels, mode):
+    """Demo actions are conditioning INPUT: lift them from labels into
+    the feature struct (predict-time they arrive via condition_labels
+    directly — the shared serving convention)."""
+    if labels is None:
+      return features
     flat = features.to_flat_dict()
-    if labels is not None:
-      flat[f"{CONDITION_LABELS}/{ACTION}"] = labels[CONDITION][ACTION]
-    features = TensorSpecStruct.from_flat_dict(flat)
-    rngs = {"dropout": rng_net} if (train and rng_net is not None) \
-        else None
-    outputs = self.network.apply({"params": params}, features,
-                                 train=train, rngs=rngs)
-    target = labels[INFERENCE][ACTION].astype(jnp.float32)
-    predicted = outputs[ACTION].astype(jnp.float32)
-    action_error = jnp.mean(jnp.abs(predicted - target))
-    mdn_params = mdn_params_from_outputs(outputs)
-    if mdn_params is not None:
-      loss = mdn_loss(mdn_params, target)
-      metrics = {"nll": loss, "action_error": action_error}
-    else:
-      loss = jnp.mean(jnp.square(predicted - target))
-      metrics = {"mse": loss, "action_error": action_error}
-    return loss, (metrics, batch_stats)
+    flat[f"{CONDITION_LABELS}/{ACTION}"] = labels[CONDITION][ACTION]
+    return TensorSpecStruct.from_flat_dict(flat)
 
-  def model_train_fn(self, features, labels, outputs, mode):
-    raise NotImplementedError(
-        "VRGripperWTLModel computes its loss in loss_fn.")
-
-  def eval_step(self, state, features, labels) -> Dict[str, jax.Array]:
-    loss, (metrics, _) = self.loss_fn(
-        state.params, state.batch_stats, features, labels, None,
-        Mode.EVAL)
-    return {"loss": loss, **metrics}
-
-  def predict_step(self, state, features) -> Any:
-    features, _ = self.preprocessor.preprocess(
-        features, None, Mode.PREDICT, None)
-    return self.network.apply({"params": state.params}, features,
-                              train=False)
+  def model_train_fn(self, features, labels, outputs, mode
+                     ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    return action_supervision_loss(outputs, labels[INFERENCE][ACTION])
