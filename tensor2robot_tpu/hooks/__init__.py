@@ -2,3 +2,7 @@
 
 from tensor2robot_tpu.hooks.hook import Hook, HookList
 from tensor2robot_tpu.hooks.async_export_hook import AsyncExportHook
+from tensor2robot_tpu.hooks.success_eval_hook import (
+    QTOptSuccessEvalHook,
+    SuccessEvalHook,
+)

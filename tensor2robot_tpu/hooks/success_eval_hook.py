@@ -1,0 +1,138 @@
+"""Per-checkpoint closed-loop success evaluation hook.
+
+Reference parity: the reference's policy checkpoints were scored by
+closed-loop success on held-out task variation, ≥500 episodes per
+checkpoint, reported per checkpoint (BASELINE.md protocol step 3); the
+reference ran this on a separate eval fleet. Here the trainer itself
+drives it after each checkpoint and a `success_rate` line lands in
+`metrics_<tag>.jsonl` next to the train/eval metrics.
+
+Two flavors:
+  * `SuccessEvalHook` — wraps any `eval_fn(predict_fn, **kwargs)`
+    protocol (evaluate_gripper_policy, evaluate_pose_model,
+    grasp2vec's evaluate_retrieval): the hook builds the batched
+    `predict(np) → np` function from the in-memory train state, so no
+    checkpoint round-trip is paid.
+  * `QTOptSuccessEvalHook` — wraps `evaluate_grasp_policy(learner,
+    state, ...)`: the CEM policy needs the learner, not predict_step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from tensor2robot_tpu import config as gin
+from tensor2robot_tpu.hooks.hook import Hook
+
+
+def _write_metrics(model_dir: str, tag: str, step: int,
+                   metrics: Dict[str, float]) -> None:
+  from tensor2robot_tpu.train_eval import MetricLogger  # lazy: cycle
+
+  logger = MetricLogger(model_dir)
+  try:
+    logger.write(tag, step, metrics)
+  finally:
+    logger.close()
+
+
+@gin.configurable
+class SuccessEvalHook(Hook):
+  """Runs `eval_fn(predict_fn, **eval_kwargs)` after each checkpoint.
+
+  Args:
+    eval_fn: e.g. `evaluate_gripper_policy`; receives a batched
+      `predict(features: np dict) -> np dict` plus `eval_kwargs`
+      (episode counts, held-out seeds/offsets — the PROTOCOL lives in
+      these kwargs; defaults in the eval fns are test-sized).
+    eval_kwargs: forwarded verbatim.
+    tag: metrics file suffix (metrics_<tag>.jsonl).
+    every_n_checkpoints: thin out when eval is expensive.
+  """
+
+  def __init__(self,
+               eval_fn: Callable[..., Dict[str, float]],
+               eval_kwargs: Optional[Dict[str, Any]] = None,
+               tag: str = "success_eval",
+               every_n_checkpoints: int = 1):
+    self._eval_fn = eval_fn
+    self._eval_kwargs = dict(eval_kwargs or {})
+    self._tag = tag
+    self._every = max(1, every_n_checkpoints)
+    self._model = None
+    self._jit_predict = None
+    self._checkpoints_seen = 0
+
+  def begin(self, model, model_dir: str) -> None:
+    self._model = model
+    self._jit_predict = None
+    self._checkpoints_seen = 0
+
+  def after_checkpoint(self, step: int, state: Any,
+                       model_dir: str) -> None:
+    self._checkpoints_seen += 1
+    if (self._checkpoints_seen - 1) % self._every:
+      return
+    import jax
+    import numpy as np
+    from tensor2robot_tpu.specs import TensorSpecStruct
+
+    if self._jit_predict is None:
+      self._jit_predict = jax.jit(self._model.predict_step)
+
+    def predict(features: Dict[str, np.ndarray]) -> Dict[str, Any]:
+      packed = TensorSpecStruct.from_flat_dict(
+          {k: np.asarray(v) for k, v in features.items()})
+      outputs = self._jit_predict(state, packed)
+      if not isinstance(outputs, dict):
+        outputs = (outputs.to_flat_dict()
+                   if hasattr(outputs, "to_flat_dict")
+                   else {"output": outputs})
+      return {k: np.asarray(jax.device_get(v))
+              for k, v in outputs.items()}
+
+    metrics = self._eval_fn(predict, **self._eval_kwargs)
+    _write_metrics(model_dir, self._tag, step, metrics)
+
+
+@gin.configurable
+class QTOptSuccessEvalHook(Hook):
+  """CEM-policy grasp success per checkpoint (QT-Opt loop).
+
+  `train_qtopt` hands hooks the critic TrainState; the CEM policy
+  reads exactly that (the target net never acts), so the hook rebuilds
+  the learner-state shim and calls `evaluate_grasp_policy`.
+  """
+
+  def __init__(self,
+               learner=None,
+               eval_kwargs: Optional[Dict[str, Any]] = None,
+               tag: str = "success_eval",
+               every_n_checkpoints: int = 1):
+    self._learner = learner
+    self._eval_kwargs = dict(eval_kwargs or {})
+    self._tag = tag
+    self._every = max(1, every_n_checkpoints)
+    self._checkpoints_seen = 0
+
+  def begin(self, model, model_dir: str) -> None:
+    self._checkpoints_seen = 0
+
+  def after_checkpoint(self, step: int, state: Any,
+                       model_dir: str) -> None:
+    self._checkpoints_seen += 1
+    if (self._checkpoints_seen - 1) % self._every:
+      return
+    from tensor2robot_tpu.research.qtopt.grasping_env import (
+        evaluate_grasp_policy,
+    )
+    from tensor2robot_tpu.research.qtopt.qtopt_learner import (
+        QTOptState,
+    )
+
+    learner_state = (state if isinstance(state, QTOptState)
+                     else QTOptState(train_state=state,
+                                     target_params=None))
+    metrics = evaluate_grasp_policy(self._learner, learner_state,
+                                    **self._eval_kwargs)
+    _write_metrics(model_dir, self._tag, step, metrics)

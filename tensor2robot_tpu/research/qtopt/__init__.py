@@ -5,6 +5,10 @@ from tensor2robot_tpu.research.qtopt.cem import (
     cem_maximize,
     make_q_score_fn,
 )
+from tensor2robot_tpu.research.qtopt.grasping_env import (
+    ToyGraspEnv,
+    evaluate_grasp_policy,
+)
 from tensor2robot_tpu.research.qtopt.networks import GraspingQNetwork
 from tensor2robot_tpu.research.qtopt.qtopt_learner import (
     QTOptLearner,

@@ -126,3 +126,28 @@ class TestPoseEnvEndToEnd:
         max_train_steps=2,
         log_every_steps=1,
     )
+
+  def test_success_eval_hook_logs_per_checkpoint(self, tmp_path):
+    """The BASELINE protocol hook: success_rate per checkpoint."""
+    import json as json_lib
+    from tensor2robot_tpu.hooks import SuccessEvalHook
+
+    model = _tiny_model()
+    model_dir = str(tmp_path / "hooked")
+    train_eval.train_eval_model(
+        model=model,
+        model_dir=model_dir,
+        input_generator_train=RandomInputGenerator(batch_size=8),
+        max_train_steps=4,
+        save_checkpoints_steps=2,
+        log_every_steps=2,
+        hooks=[SuccessEvalHook(
+            eval_fn=evaluate_pose_model,
+            eval_kwargs={"num_episodes": 4, "image_size": 32,
+                         "seed": 9})],
+    )
+    path = os.path.join(model_dir, "metrics_success_eval.jsonl")
+    records = [json_lib.loads(line) for line in open(path)]
+    # One protocol line per checkpoint, each carrying success_rate.
+    assert [r["step"] for r in records] == [2, 4]
+    assert all("success_rate" in r for r in records)
