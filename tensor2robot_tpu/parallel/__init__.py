@@ -13,6 +13,11 @@ from tensor2robot_tpu.parallel.mesh import (
 from tensor2robot_tpu.parallel.distributed import (
     maybe_initialize_distributed,
 )
+from tensor2robot_tpu.parallel.ring_attention import (
+    attention_reference,
+    ring_attention,
+    sequence_sharding,
+)
 from tensor2robot_tpu.parallel.sharding import (
     fsdp_sharding,
     state_sharding,
