@@ -1,0 +1,3 @@
+"""Pallas TPU kernels for the framework's hot ops."""
+
+from tensor2robot_tpu.ops.flash_attention import flash_attention

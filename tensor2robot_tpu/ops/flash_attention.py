@@ -1,0 +1,136 @@
+"""Pallas TPU flash attention: the long-context single-chip hot op.
+
+The framework's attention surfaces (SNAIL trunks, ring attention's
+per-device blocks) are MXU-dominated but HBM-limited at long sequence
+lengths: materializing [T, T] scores costs O(T²) HBM traffic, which is
+exactly what the memory hierarchy punishes (HBM → VMEM → MXU;
+/opt/skills/guides/pallas_guide.md). This kernel computes exact
+attention in O(T) memory: Q/K/V stream through VMEM in (block_q,
+block_k) tiles, scores live only in registers/VMEM, and the online
+softmax carries running max/normalizer/accumulator in f32 scratch.
+
+Pairs with `parallel/ring_attention.py`: the ring shards the sequence
+ACROSS chips (ppermute over ICI), this kernel tiles it WITHIN a chip;
+both implement the same online-softmax math.
+
+`flash_attention(..., interpret=True)` runs the kernel in the pallas
+interpreter — how the CPU test suite verifies numerics without TPU
+hardware.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                  scale: float, causal: bool, block_q: int,
+                  block_k: int, num_k_blocks: int):
+  """Grid (batch*heads, T/block_q, T/block_k); innermost dim iterates
+  K/V blocks sequentially (TPU grids are loops), accumulating into
+  VMEM scratch; the last K step normalizes and writes the output."""
+  j = pl.program_id(2)
+
+  @pl.when(j == 0)
+  def _init():
+    m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+    l_scr[...] = jnp.zeros_like(l_scr)
+    acc_scr[...] = jnp.zeros_like(acc_scr)
+
+  q = q_ref[0]  # [block_q, D]
+  k = k_ref[0]  # [block_k, D]
+  s = jax.lax.dot_general(
+      q, k, (((1,), (1,)), ((), ())),
+      preferred_element_type=jnp.float32) * scale  # [block_q, block_k]
+
+  if causal:
+    i = pl.program_id(1)
+    rows = i * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    cols = j * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    mask = cols <= rows
+    s = jnp.where(mask, s, _NEG_INF)
+
+  m_prev = m_scr[...]
+  m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+  p = jnp.exp(s - m_new)
+  if causal:
+    p = jnp.where(mask, p, 0.0)
+  alpha = jnp.exp(m_prev - m_new)
+  l_scr[...] = alpha * l_scr[...] + p.sum(axis=-1, keepdims=True)
+  acc_scr[...] = alpha * acc_scr[...] + jax.lax.dot_general(
+      p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+      preferred_element_type=jnp.float32)
+  m_scr[...] = m_new
+
+  @pl.when(j == num_k_blocks - 1)
+  def _finalize():
+    o_ref[0] = (acc_scr[...]
+                / jnp.maximum(l_scr[...], 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k",
+                              "interpret"))
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+  """Exact attention, O(T) memory. q/k/v: [B, T, H, D] → [B, T, H, D].
+
+  T must divide by the block sizes (pad upstream — robot episode and
+  context lengths are static in this framework by construction).
+  """
+  b, t, h, d = q.shape
+  block_q = min(block_q, t)
+  block_k = min(block_k, t)
+  if t % block_q or t % block_k:
+    raise ValueError(
+        f"Sequence length {t} must divide block sizes "
+        f"({block_q}, {block_k}).")
+  num_q_blocks = t // block_q
+  num_k_blocks = t // block_k
+  scale = 1.0 / np.sqrt(d)
+
+  # [B, T, H, D] -> [B*H, T, D]: one grid row per (batch, head).
+  def fold(x):
+    return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+  q_f, k_f, v_f = fold(q), fold(k), fold(v)
+
+  kernel = functools.partial(
+      _flash_kernel, scale=scale, causal=causal, block_q=block_q,
+      block_k=block_k, num_k_blocks=num_k_blocks)
+  out = pl.pallas_call(
+      kernel,
+      grid=(b * h, num_q_blocks, num_k_blocks),
+      in_specs=[
+          pl.BlockSpec((1, block_q, d), lambda g, i, j: (g, i, 0)),
+          pl.BlockSpec((1, block_k, d), lambda g, i, j: (g, j, 0)),
+          pl.BlockSpec((1, block_k, d), lambda g, i, j: (g, j, 0)),
+      ],
+      out_specs=pl.BlockSpec((1, block_q, d), lambda g, i, j: (g, i, 0)),
+      out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+      scratch_shapes=[
+          pltpu.VMEM((block_q, 1), jnp.float32),   # running max
+          pltpu.VMEM((block_q, 1), jnp.float32),   # running normalizer
+          pltpu.VMEM((block_q, d), jnp.float32),   # output accumulator
+      ],
+      interpret=interpret,
+  )(q_f, k_f, v_f)
+  return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
