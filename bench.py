@@ -166,6 +166,60 @@ def bench_config(paper: bool, profile_dir=None):
   }
 
 
+def bench_input_pipeline(batch_size: int = 256, image_size: int = 64,
+                         num_records: int = 2048, batches: int = 40):
+  """Host tf.data pipeline rate at the bench config (jpeg decode).
+
+  The question the number answers: can ONE host feed one chip's
+  measured Bellman-step rate at the bench batch size? (SURVEY §4.3 —
+  parse + decode run inside the tf.data graph under AUTOTUNE.)
+  """
+  import os
+  import tempfile
+
+  import tensorflow as tf  # noqa: F401 — required for the pipeline
+
+  from tensor2robot_tpu.data.abstract_input_generator import Mode
+  from tensor2robot_tpu.data.tfrecord_input_generator import (
+      TFRecordInputGenerator,
+      write_tfrecord,
+  )
+  from tensor2robot_tpu.specs import ExtendedTensorSpec, TensorSpecStruct
+
+  spec = TensorSpecStruct()
+  spec.image = ExtendedTensorSpec(
+      shape=(image_size, image_size, 3), dtype=np.uint8, name="image",
+      data_format="jpeg")
+  spec.action = ExtendedTensorSpec(shape=(4,), dtype=np.float32,
+                                   name="action")
+  rng = np.random.default_rng(0)
+  with tempfile.TemporaryDirectory() as tmp:
+    path = os.path.join(tmp, "bench.tfrecord")
+    write_tfrecord(
+        path,
+        [{"image": rng.integers(0, 255, (image_size, image_size, 3)
+                                ).astype(np.uint8),
+          "action": rng.standard_normal(4).astype(np.float32)}
+         for _ in range(num_records)],
+        spec)
+    gen = TFRecordInputGenerator(
+        file_patterns=path, batch_size=batch_size,
+        shuffle_buffer_size=num_records, seed=0)
+    gen.set_specification(spec, None)
+    it = gen.create_dataset(Mode.TRAIN)
+    next(it)  # warm the pipeline
+    t0 = time.perf_counter()
+    for _ in range(batches):
+      next(it)
+    rate = batches / (time.perf_counter() - t0)
+  return {
+      "config": (f"batch={batch_size}, {image_size}x{image_size} jpeg "
+                 f"decode in tf.data graph (AUTOTUNE)"),
+      "batches_per_sec": round(rate, 2),
+      "images_per_sec": round(rate * batch_size, 1),
+  }
+
+
 def main():
   args = sys.argv[1:]
   profile_dir = None
@@ -176,6 +230,11 @@ def main():
   detail = {"primary": bench_config(False, profile_dir=profile_dir)}
   if run_paper:
     detail["paper_scale"] = bench_config(True)
+  if "--input" in args:
+    detail["input_pipeline"] = bench_input_pipeline()
+    detail["input_pipeline"]["feeds_chip"] = bool(
+        detail["input_pipeline"]["batches_per_sec"]
+        >= detail["primary"]["steps_per_sec_best"])
 
   with open("BENCH_DETAIL.json", "w") as f:
     json.dump(detail, f, indent=2)
