@@ -165,24 +165,33 @@ class GraspingQNetwork(nn.Module):
       v = conv0(basis)  # [C, h', w', C']
       if not self.use_batch_norm:  # bias active ⇒ remove from basis rows
         v = v - conv0(jnp.zeros((1,) + encoded.shape[1:], self.dtype))
-      act = jnp.einsum("bpc,chwo->bphwo", a, v,
-                       preferred_element_type=self.dtype)
       if self.use_batch_norm:
         # Eval-mode BN is per-channel affine: BN(enc0 + act) =
-        # BN(enc0) + s·act. Fold it this way so the big [B, P, h', w',
-        # C'] tensor never enters flax BN (whose float32 internals
-        # force a layout-changing f32 copy of the whole tensor —
-        # profiled as the top op of the Bellman step).
+        # BN(enc0) + s·act. Fold s into the tap-sum tensor so the big
+        # population tensor never enters flax BN (whose float32
+        # internals force a layout-changing f32 copy of the whole
+        # tensor — profiled as the top op of the Bellman step).
         bn0 = self._head_bns[0]
-        out_c = act.shape[-1]
+        out_c = v.shape[-1]
         shift = bn0(jnp.zeros((1, 1, 1, out_c), self.dtype),
                     use_running_average=True)
         scale = bn0(jnp.ones((1, 1, 1, out_c), self.dtype),
                     use_running_average=True) - shift
         enc0 = bn0(enc0, use_running_average=True)
-        act = act * scale[None].astype(self.dtype)
-      x = enc0[:, None].astype(self.dtype) + act
-      x = nn.relu(x.reshape((b * p,) + x.shape[2:]))
+        v = v * scale.astype(self.dtype)
+      # The action contribution as a flat [B*P, h'·w'·C'] GEMM rather
+      # than a bphwo einsum: the 5-D einsum output gets a batch-minor
+      # layout that forces a transpose copy of the whole population
+      # tensor before the next conv (profiled at ~60% of the Bellman
+      # step); the 2-D GEMM + broadcast-add form lays out NHWC
+      # directly (measured 225 -> 362 fused steps/s end to end).
+      h2, w2, oc = v.shape[1:]
+      act = (a.reshape(b * p, c) @ v.reshape(c, -1)).reshape(
+          b * p, h2, w2, oc)
+      enc_rep = jnp.broadcast_to(
+          enc0[:, None].astype(self.dtype),
+          (b, p, h2, w2, oc)).reshape(b * p, h2, w2, oc)
+      x = nn.relu(act + enc_rep)
       for i, conv in enumerate(self._head_convs[1:], start=1):
         x = conv(x)
         if self.use_batch_norm:
