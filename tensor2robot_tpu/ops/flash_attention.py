@@ -85,7 +85,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
   def _finalize():
     l_final = jnp.maximum(l_scr[...], 1e-30)
     o_ref[0] = (acc_scr[...] / l_final).astype(o_ref.dtype)
-    lse_ref[0] = (m_scr[...] + jnp.log(l_final))[:, 0]
+    # Broadcast across a 128-lane dim: TPU block shapes need the last
+    # dim divisible by 128, so the per-row scalar rides 128 lanes.
+    lse_ref[0] = jnp.broadcast_to(
+        m_scr[...] + jnp.log(l_final), (block_q, 128))
 
 
 def _flash_forward_impl(q, k, v, causal: bool, block_q: int,
@@ -114,11 +117,11 @@ def _flash_forward_impl(q, k, v, causal: bool, block_q: int,
       ],
       out_specs=[
           pl.BlockSpec((1, block_q, d), lambda g, i, j: (g, i, 0)),
-          pl.BlockSpec((1, block_q), lambda g, i, j: (g, i)),
+          pl.BlockSpec((1, block_q, 128), lambda g, i, j: (g, i, 0)),
       ],
       out_shape=[
           jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
-          jax.ShapeDtypeStruct((b * h, t), jnp.float32),
+          jax.ShapeDtypeStruct((b * h, t, 128), jnp.float32),
       ],
       scratch_shapes=[
           pltpu.VMEM((block_q, 1), jnp.float32),   # running max
@@ -127,7 +130,7 @@ def _flash_forward_impl(q, k, v, causal: bool, block_q: int,
       ],
       interpret=interpret,
   )(fold(q), fold(k), fold(v))
-  return out.reshape(b, h, t, d).transpose(0, 2, 1, 3), lse
+  return out.reshape(b, h, t, d).transpose(0, 2, 1, 3), lse[..., 0]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
