@@ -32,3 +32,8 @@ from tensor2robot_tpu.layers.snail import (
     SNAIL,
     TCBlock,
 )
+from tensor2robot_tpu.layers.transformer import (
+    CausalTransformer,
+    MultiHeadAttention,
+    TransformerBlock,
+)

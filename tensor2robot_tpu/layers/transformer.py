@@ -1,0 +1,135 @@
+"""Causal transformer trunk with pluggable attention backends.
+
+The reference's sequence models were SNAIL-style causal convs +
+single-head attention over short episodes (`layers/snail.py` parity
+module); this trunk is the long-context counterpart the TPU stack
+makes first-class: the same module scales from short demo episodes to
+32k-step contexts by swapping the attention implementation —
+
+  * "reference": materialized softmax attention (CPU tests, short T),
+  * "flash": the Pallas O(T)-memory kernel (`ops/flash_attention.py`),
+  * "ring": sequence-parallel across chips
+    (`parallel/ring_attention.py`, pass `mesh`),
+  * "auto": flash on TPU, reference elsewhere.
+
+All backends compute EXACT attention, so checkpoints are portable
+across them (train with ring on a pod, serve with flash on one chip).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def _attend(q, k, v, *, impl: str, causal: bool, mesh) -> jax.Array:
+  """Dispatches [B, T, H, D] attention to the chosen backend."""
+  from tensor2robot_tpu.ops import flash_attention
+  from tensor2robot_tpu.parallel import (
+      attention_reference,
+      ring_attention,
+  )
+
+  if impl == "auto":
+    on_tpu = jax.devices()[0].platform == "tpu"
+    impl = "flash" if on_tpu else "reference"
+  if impl == "flash":
+    return flash_attention(q, k, v, causal=causal)
+  if impl == "ring":
+    return ring_attention(q, k, v, mesh=mesh, causal=causal)
+  if impl == "reference":
+    return attention_reference(q, k, v, causal=causal)
+  raise ValueError(f"Unknown attention impl: {impl!r}")
+
+
+class MultiHeadAttention(nn.Module):
+  """QKV projections around a pluggable exact-attention backend."""
+
+  num_heads: int
+  head_dim: int
+  attention_impl: str = "reference"
+  causal: bool = True
+  mesh: Optional[Any] = None
+  dtype: Any = jnp.bfloat16
+
+  @nn.compact
+  def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+    b, t, _ = x.shape
+    h, d = self.num_heads, self.head_dim
+    x = x.astype(self.dtype)
+    qkv = nn.Dense(3 * h * d, use_bias=False, dtype=self.dtype,
+                   name="qkv")(x)
+    q, k, v = jnp.split(qkv.reshape(b, t, 3 * h, d), 3, axis=2)
+    out = _attend(q, k, v, impl=self.attention_impl,
+                  causal=self.causal, mesh=self.mesh)
+    out = out.reshape(b, t, h * d)
+    return nn.Dense(x.shape[-1], dtype=self.dtype, name="proj")(out)
+
+
+class TransformerBlock(nn.Module):
+  """Pre-LN block: x + MHA(LN(x)); x + MLP(LN(x))."""
+
+  num_heads: int
+  head_dim: int
+  mlp_ratio: int = 4
+  attention_impl: str = "reference"
+  causal: bool = True
+  mesh: Optional[Any] = None
+  dtype: Any = jnp.bfloat16
+
+  @nn.compact
+  def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+    width = x.shape[-1]
+    y = nn.LayerNorm(dtype=self.dtype, name="ln_attn")(x)
+    x = x + MultiHeadAttention(
+        num_heads=self.num_heads, head_dim=self.head_dim,
+        attention_impl=self.attention_impl, causal=self.causal,
+        mesh=self.mesh, dtype=self.dtype, name="attn")(y, train=train)
+    y = nn.LayerNorm(dtype=self.dtype, name="ln_mlp")(x)
+    y = nn.Dense(width * self.mlp_ratio, dtype=self.dtype,
+                 name="mlp_in")(y)
+    y = nn.gelu(y)
+    y = nn.Dense(width, dtype=self.dtype, name="mlp_out")(y)
+    return x + y
+
+
+class CausalTransformer(nn.Module):
+  """Embedding + learned positions + N blocks + final LN.
+
+  Input: per-step feature vectors [B, T, F]; output [B, T, width].
+  `max_len` bounds the learned positional table (positions are static
+  in this framework — episode/context lengths come from specs).
+  """
+
+  width: int
+  depth: int
+  num_heads: int
+  max_len: int
+  attention_impl: str = "reference"
+  causal: bool = True
+  mesh: Optional[Any] = None
+  dtype: Any = jnp.bfloat16
+
+  @nn.compact
+  def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+    b, t, _ = x.shape
+    if t > self.max_len:
+      raise ValueError(f"sequence length {t} > max_len {self.max_len}")
+    head_dim = self.width // self.num_heads
+    x = nn.Dense(self.width, dtype=self.dtype, name="embed")(
+        x.astype(self.dtype))
+    positions = self.param(
+        "positions", nn.initializers.normal(0.02),
+        (self.max_len, self.width))
+    x = x + positions[None, :t].astype(self.dtype)
+    for i in range(self.depth):
+      x = TransformerBlock(
+          num_heads=self.num_heads, head_dim=head_dim,
+          attention_impl=self.attention_impl, causal=self.causal,
+          mesh=self.mesh, dtype=self.dtype, name=f"block{i}",
+      )(x, train=train)
+    return nn.LayerNorm(dtype=self.dtype, name="ln_out")(
+        x).astype(jnp.float32)

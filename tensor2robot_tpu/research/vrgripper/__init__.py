@@ -25,6 +25,9 @@ from tensor2robot_tpu.research.vrgripper.vrgripper_meta_models import (
     VRGripperMAMLModel,
     VRGripperSNAILModel,
 )
+from tensor2robot_tpu.research.vrgripper.vrgripper_transformer_models import (  # noqa: E501
+    VRGripperTransformerModel,
+)
 from tensor2robot_tpu.research.vrgripper.vrgripper_wtl_models import (
     VRGripperWTLModel,
 )

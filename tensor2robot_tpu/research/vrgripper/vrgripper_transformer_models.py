@@ -1,0 +1,165 @@
+"""Long-context transformer BC over full gripper episodes.
+
+The reference's sequence policies were SNAIL-style causal convs over
+short fixed windows (`vrgripper_env_meta_models.py` parity lives in
+`vrgripper_meta_models.py`); this model is the framework's long-context
+counterpart: behavioral cloning where the policy attends over the
+ENTIRE episode history — the regime the TPU stack makes first-class
+(flash attention within a chip, ring attention across chips; same
+exact-attention math, so checkpoints are portable between backends).
+
+Consumes episode batches straight from `TFRecordEpisodeInputGenerator`
+(image/gripper_pose sequences + true lengths; the wire layout
+`collect_demo_episodes` writes), encodes each step with the shared
+`GripperObsEncoder` folded into one conv batch, runs the causal
+transformer over steps, and clones per-step actions with a
+length-masked loss — padding steps never contribute gradient.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu import config as gin
+from tensor2robot_tpu.data.abstract_input_generator import Mode
+from tensor2robot_tpu.data.tfexample import SEQUENCE_LENGTH_KEY
+from tensor2robot_tpu.layers.transformer import CausalTransformer
+from tensor2robot_tpu.models.abstract_model import AbstractT2RModel
+from tensor2robot_tpu.models.regression_model import INFERENCE_OUTPUT
+from tensor2robot_tpu.research.vrgripper.vrgripper_models import (
+    ACTION,
+    GripperObsEncoder,
+)
+from tensor2robot_tpu.specs import (
+    ExtendedTensorSpec,
+    TensorSpecStruct,
+)
+
+
+class _EpisodeTransformerNet(nn.Module):
+  """Per-step obs encoder → causal transformer → per-step actions."""
+
+  action_dim: int
+  filters: Sequence[int]
+  embedding_size: int
+  width: int
+  depth: int
+  num_heads: int
+  max_len: int
+  attention_impl: str
+  dtype: Any = jnp.bfloat16
+
+  @nn.compact
+  def __call__(self, features, train: bool = False):
+    flat = (features.to_flat_dict()
+            if hasattr(features, "to_flat_dict") else dict(features))
+    image = flat["image"]
+    pose = flat["gripper_pose"]
+    b, t = image.shape[:2]
+    # All steps of all episodes through ONE conv batch (MXU-sized).
+    folded = TensorSpecStruct.from_flat_dict({
+        "image": image.reshape((b * t,) + image.shape[2:]),
+        "gripper_pose": pose.reshape((b * t,) + pose.shape[2:]),
+    })
+    emb = GripperObsEncoder(
+        filters=tuple(self.filters),
+        embedding_size=self.embedding_size,
+        use_batch_norm=False, dtype=self.dtype,
+        name="obs_encoder")(folded, train=train)
+    emb = emb.reshape(b, t, -1)
+    trunk = CausalTransformer(
+        width=self.width, depth=self.depth, num_heads=self.num_heads,
+        max_len=self.max_len, attention_impl=self.attention_impl,
+        causal=True, dtype=self.dtype, name="trunk")(emb, train=train)
+    action = nn.Dense(self.action_dim, dtype=self.dtype,
+                      name="action_head")(
+        trunk.astype(self.dtype)).astype(jnp.float32)
+    return {ACTION: action, INFERENCE_OUTPUT: action}
+
+
+@gin.configurable
+class VRGripperTransformerModel(AbstractT2RModel):
+  """Episode-level BC: every action conditioned on the full history."""
+
+  def __init__(self,
+               image_size: int = 48,
+               state_dim: int = 3,
+               action_dim: int = 3,
+               filters: Sequence[int] = (16, 32),
+               embedding_size: int = 64,
+               width: int = 64,
+               depth: int = 2,
+               num_heads: int = 4,
+               max_context_length: int = 512,
+               attention_impl: str = "auto",
+               device_dtype=jnp.bfloat16,
+               **kwargs):
+    super().__init__(device_dtype=device_dtype, **kwargs)
+    self._image_size = image_size
+    self._state_dim = state_dim
+    self._action_dim = action_dim
+    self._filters = tuple(filters)
+    self._embedding_size = embedding_size
+    self._width = width
+    self._depth = depth
+    self._num_heads = num_heads
+    self._max_len = max_context_length
+    self._attention_impl = attention_impl
+
+  def get_feature_specification(self, mode: Mode) -> TensorSpecStruct:
+    st = TensorSpecStruct()
+    st.image = ExtendedTensorSpec(
+        shape=(self._image_size, self._image_size, 3), dtype=np.uint8,
+        name="image", data_format="png", is_sequence=True)
+    st.gripper_pose = ExtendedTensorSpec(
+        shape=(self._state_dim,), dtype=np.float32,
+        name="gripper_pose", is_sequence=True)
+    # NOTE: the true episode lengths arrive as the episode generator's
+    # extra `sequence_length` key (reserved — the parser forbids
+    # declaring it as a spec); the masked loss picks it up when
+    # present and treats all steps as real otherwise.
+    return st
+
+  def get_label_specification(self, mode: Mode) -> TensorSpecStruct:
+    st = TensorSpecStruct()
+    st.action = ExtendedTensorSpec(
+        shape=(self._action_dim,), dtype=np.float32, name=ACTION,
+        is_sequence=True)
+    return st
+
+  def create_network(self) -> nn.Module:
+    return _EpisodeTransformerNet(
+        action_dim=self._action_dim,
+        filters=self._filters,
+        embedding_size=self._embedding_size,
+        width=self._width,
+        depth=self._depth,
+        num_heads=self._num_heads,
+        max_len=self._max_len,
+        attention_impl=self._attention_impl,
+        dtype=self.device_dtype,
+    )
+
+  def model_train_fn(self, features, labels, outputs, mode
+                     ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    target = labels[ACTION].astype(jnp.float32)      # [B, T, A]
+    predicted = outputs[ACTION].astype(jnp.float32)
+    b, t = target.shape[:2]
+    flat = features.to_flat_dict()
+    if SEQUENCE_LENGTH_KEY in flat:
+      lengths = flat[SEQUENCE_LENGTH_KEY].reshape(b)
+      mask = (jnp.arange(t)[None, :]
+              < lengths[:, None]).astype(jnp.float32)
+    else:
+      mask = jnp.ones((b, t), jnp.float32)
+    denom = jnp.maximum(mask.sum(), 1.0)
+    sq = jnp.sum(jnp.square(predicted - target), axis=-1)
+    loss = jnp.sum(sq * mask) / denom
+    action_error = jnp.sum(
+        jnp.sum(jnp.abs(predicted - target), axis=-1) * mask) / denom
+    return loss, {"mse": loss, "action_error": action_error}
