@@ -8,6 +8,11 @@ from tensor2robot_tpu.research.grasp2vec.grasp2vec_model import (
     PREGRASP_EMBEDDING,
     SCENE_SPATIAL,
 )
+from tensor2robot_tpu.research.grasp2vec.goal_reward import (
+    GOAL_EMBEDDING_FEATURE,
+    make_grasp2vec_reward_fn,
+    relabel_transitions,
+)
 from tensor2robot_tpu.research.grasp2vec.grasp_env import (
     GraspSceneGenerator,
     collect_grasp_triplets,

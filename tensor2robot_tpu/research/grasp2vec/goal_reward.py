@@ -1,0 +1,96 @@
+"""Grasp2Vec → QT-Opt glue: self-supervised goal-conditioned rewards.
+
+Reference parity: grasp2vec existed to LABEL grasping data — the paper
+(arXiv:1811.06964 §4) trains goal-conditioned QT-Opt with reward
+1[cos(φ(pre) − φ(post), ψ(goal)) > threshold] instead of human labels.
+The reference repo shipped the embedding model; this module ships the
+actual handoff: a jitted reward labeler and a transition relabeler
+that emits the QT-Opt replay layout (goal embedding riding as an
+extra state feature of the Q-function).
+
+One device program per batch: both embedding towers + the cosine +
+the threshold run fused; the output feeds `ReplayBuffer.add` directly.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu.research.grasp2vec.grasp2vec_model import (
+    GOAL_EMBEDDING,
+    GOAL_REWARD,
+    Grasp2VecModel,
+)
+from tensor2robot_tpu.specs import TensorSpecStruct
+
+GOAL_EMBEDDING_FEATURE = "goal_embedding"
+
+
+def make_grasp2vec_reward_fn(
+    model: Grasp2VecModel,
+    state,
+    threshold: float = 0.5,
+    binary: bool = True,
+) -> Callable[[np.ndarray, np.ndarray, np.ndarray], Dict[str, np.ndarray]]:
+  """Builds `(pregrasp, postgrasp, goal) → {reward, goal_embedding}`.
+
+  `binary=True` applies the paper's success threshold on the cosine;
+  otherwise the raw similarity is the (shaped) reward. Also returns
+  ψ(goal) so relabeled transitions can condition the Q-function.
+  """
+  jitted = jax.jit(model.predict_step)
+
+  def reward_fn(pregrasp_image, postgrasp_image, goal_image):
+    features = TensorSpecStruct.from_flat_dict({
+        "pregrasp_image": jnp.asarray(pregrasp_image),
+        "postgrasp_image": jnp.asarray(postgrasp_image),
+        "goal_image": jnp.asarray(goal_image),
+    })
+    outputs = jitted(state, features)
+    similarity = np.asarray(jax.device_get(outputs[GOAL_REWARD]),
+                            np.float32)
+    reward = ((similarity > threshold).astype(np.float32)
+              if binary else similarity)
+    return {
+        "reward": reward,
+        "similarity": similarity,
+        GOAL_EMBEDDING_FEATURE: np.asarray(
+            jax.device_get(outputs[GOAL_EMBEDDING]), np.float32),
+    }
+
+  return reward_fn
+
+
+def relabel_transitions(
+    reward_fn,
+    pregrasp_images: np.ndarray,
+    postgrasp_images: np.ndarray,
+    goal_images: np.ndarray,
+    actions: np.ndarray,
+    next_images: Optional[np.ndarray] = None,
+) -> Dict[str, np.ndarray]:
+  """Grasping attempts → QT-Opt replay transitions, grasp2vec-labeled.
+
+  Output layout matches `QTOptLearner.transition_specification()` for
+  a `GraspingQModel(extra_state_features={"goal_embedding": (D,)})`:
+  the scene image + goal embedding are the state, the attempt is the
+  action, the self-supervised outcome similarity is the reward, and
+  episodes are single-step grasps (done=1, paper's setting).
+  """
+  labels = reward_fn(pregrasp_images, postgrasp_images, goal_images)
+  n = pregrasp_images.shape[0]
+  goal_emb = labels[GOAL_EMBEDDING_FEATURE]
+  return {
+      "image": pregrasp_images,
+      GOAL_EMBEDDING_FEATURE: goal_emb,
+      "action": np.asarray(actions, np.float32),
+      "reward": labels["reward"][:, None],
+      "done": np.ones((n, 1), np.float32),
+      "next_image": (postgrasp_images if next_images is None
+                     else next_images),
+      f"next_{GOAL_EMBEDDING_FEATURE}": goal_emb,
+  }

@@ -217,6 +217,109 @@ class TestGrasp2VecEndToEnd:
     assert np.asarray(out[SCENE_SPATIAL]).ndim == 4
 
 
+class TestGoalConditionedRewardHandoff:
+  """The paper's pipeline: grasp2vec labels goal-conditioned QT-Opt."""
+
+  def test_reward_separates_matched_from_mismatched(self, run=None):
+    # Train a quick model inline (class-scoped e2e fixture lives in
+    # another class); tiny and fast is enough for separation.
+    import jax
+    from tensor2robot_tpu.research.grasp2vec import (
+        make_grasp2vec_reward_fn,
+    )
+    from tensor2robot_tpu.specs import TensorSpecStruct
+
+    model = tiny_model()
+    state = model.create_train_state(jax.random.PRNGKey(0))
+    gen = GraspSceneGenerator(image_size=IMG,
+                              num_object_types=NUM_TYPES,
+                              num_distractors=1, seed=0)
+    train_step = jax.jit(model.train_step)
+    import jax.numpy as jnp_
+    for i in range(120):
+      triplets = [gen.sample() for _ in range(16)]
+      feats = TensorSpecStruct.from_flat_dict({
+          k: jnp_.asarray(np.stack([t[k] for t in triplets]))
+          for k in ("pregrasp_image", "postgrasp_image", "goal_image")})
+      labels = TensorSpecStruct.from_flat_dict({
+          "object_id": jnp_.asarray(
+              np.stack([t["object_id"] for t in triplets]))})
+      state, _ = train_step(state, feats, labels, jax.random.PRNGKey(i))
+
+    reward_fn = make_grasp2vec_reward_fn(model, state, threshold=0.5)
+    eval_gen = GraspSceneGenerator(image_size=IMG,
+                                   num_object_types=NUM_TYPES,
+                                   num_distractors=1, seed=7)
+    triplets = [eval_gen.sample() for _ in range(24)]
+    pre = np.stack([t["pregrasp_image"] for t in triplets])
+    post = np.stack([t["postgrasp_image"] for t in triplets])
+    goal = np.stack([t["goal_image"] for t in triplets])
+    ids = np.array([int(t["object_id"]) for t in triplets])
+
+    matched = reward_fn(pre, post, goal)
+    rolled = np.roll(goal, 1, axis=0)
+    keep = ids != np.roll(ids, 1)
+    mismatched = reward_fn(pre, post, rolled)
+    # Self-supervised success labels: matched mostly 1, mismatched
+    # (different object) mostly 0.
+    assert matched["reward"].mean() > 0.75, matched["reward"].mean()
+    assert mismatched["reward"][keep].mean() < 0.3
+    self._state = (model, state)  # reuse in the relabel test
+
+  def test_relabeled_transitions_train_goal_conditioned_qtopt(self):
+    import jax
+    import jax.numpy as jnp_
+    from tensor2robot_tpu.research.grasp2vec import (
+        GOAL_EMBEDDING_FEATURE,
+        make_grasp2vec_reward_fn,
+        relabel_transitions,
+    )
+    from tensor2robot_tpu.research.qtopt import (
+        GraspingQModel,
+        QTOptLearner,
+        ReplayBuffer,
+    )
+
+    g2v = tiny_model()
+    g2v_state = g2v.create_train_state(jax.random.PRNGKey(0))
+    reward_fn = make_grasp2vec_reward_fn(g2v, g2v_state, threshold=0.4)
+
+    gen = GraspSceneGenerator(image_size=IMG,
+                              num_object_types=NUM_TYPES,
+                              num_distractors=1, seed=3)
+    triplets = [gen.sample() for _ in range(16)]
+    rng = np.random.default_rng(0)
+    transitions = relabel_transitions(
+        reward_fn,
+        np.stack([t["pregrasp_image"] for t in triplets]),
+        np.stack([t["postgrasp_image"] for t in triplets]),
+        np.stack([t["goal_image"] for t in triplets]),
+        actions=rng.uniform(-1, 1, (16, 2)).astype(np.float32),
+    )
+    assert set(np.unique(transitions["reward"])) <= {0.0, 1.0}
+
+    # Goal-conditioned Q: ψ(goal) rides as an extra state feature.
+    q_model = GraspingQModel(
+        image_size=IMG, action_dim=2, torso_filters=(8,),
+        head_filters=(8,), dense_sizes=(16,),
+        extra_state_features={
+            GOAL_EMBEDDING_FEATURE: (g2v.embedding_size,)})
+    learner = QTOptLearner(q_model, cem_population=4,
+                           cem_iterations=1, cem_elites=2)
+    spec_keys = set(learner.transition_specification().to_flat_dict())
+    assert set(transitions) == spec_keys, (
+        set(transitions) ^ spec_keys)
+    replay = ReplayBuffer(learner.transition_specification(),
+                          capacity=64)
+    replay.add(transitions)
+    state = learner.create_state(jax.random.PRNGKey(1))
+    batch = replay.sample(8)
+    batch = jax.tree_util.tree_map(jnp_.asarray, batch)
+    state, metrics = jax.jit(learner.train_step)(
+        state, batch, jax.random.PRNGKey(3))
+    assert np.isfinite(float(metrics["loss"]))
+
+
 class TestShippedConfig:
 
   def test_config_parses_and_builds_model(self):
