@@ -305,13 +305,17 @@ def evaluate_gripper_policy(
   """Closed-loop policy rollout; returns success rate + final distance.
 
   `predict_fn` maps a batched feature dict {image, gripper_pose} to an
-  output dict containing the action (the predictor API).
+  output dict containing the action (the predictor API). Stateful
+  policies (e.g. full-history transformer policies) expose a
+  `.reset()` method, called at each episode boundary.
   """
   env = VRGripperEnv(image_size=image_size, seed=seed,
                      task_offset_scale=task_offset_scale)
   successes, final_dists = [], []
   for _ in range(num_episodes):
     obs = env.reset()
+    if hasattr(predict_fn, "reset"):
+      predict_fn.reset()
     done = False
     while not done:
       batch = {"image": obs["image"][None],

@@ -145,6 +145,13 @@ class VRGripperTransformerModel(AbstractT2RModel):
         dtype=self.device_dtype,
     )
 
+  def make_context_policy(self, state,
+                          context_length: Optional[int] = None
+                          ) -> "EpisodeContextPolicy":
+    """A closed-loop policy that feeds the growing episode history."""
+    return EpisodeContextPolicy(
+        self, state, context_length or self._max_len)
+
   def model_train_fn(self, features, labels, outputs, mode
                      ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     target = labels[ACTION].astype(jnp.float32)      # [B, T, A]
@@ -163,3 +170,47 @@ class VRGripperTransformerModel(AbstractT2RModel):
     action_error = jnp.sum(
         jnp.sum(jnp.abs(predicted - target), axis=-1) * mask) / denom
     return loss, {"mse": loss, "action_error": action_error}
+
+
+class EpisodeContextPolicy:
+  """On-robot wrapper: accumulates history, serves the latest action.
+
+  The control loop calls `policy(single_observation_batch)` per step
+  and `policy.reset()` at episode boundaries (the protocol
+  `evaluate_gripper_policy` speaks). History is padded to the FIXED
+  context length, so one compiled program serves every step —
+  XLA-friendly static shapes, causal masking makes padding harmless.
+  """
+
+  def __init__(self, model: VRGripperTransformerModel, state,
+               context_length: int):
+    self._model = model
+    self._state = state
+    self._t = context_length
+    self._jit = jax.jit(model.predict_step)
+    self._history: list = []
+
+  def reset(self) -> None:
+    self._history = []
+
+  def __call__(self, features: Dict[str, np.ndarray]
+               ) -> Dict[str, np.ndarray]:
+    obs = {k: np.asarray(v)[0] for k, v in features.items()}
+    self._history.append(obs)
+    self._history = self._history[-self._t:]
+    steps = len(self._history)
+
+    def pad(key):
+      stacked = np.stack([h[key] for h in self._history])
+      return np.pad(
+          stacked,
+          [(0, self._t - steps)] + [(0, 0)] * (stacked.ndim - 1))
+
+    batch = TensorSpecStruct.from_flat_dict({
+        "image": jnp.asarray(pad("image")[None]),
+        "gripper_pose": jnp.asarray(pad("gripper_pose")[None]),
+    })
+    outputs = self._jit(self._state, batch)
+    action = np.asarray(jax.device_get(outputs[ACTION]))
+    # The CURRENT step's action is at the last real history slot.
+    return {ACTION: action[:, steps - 1]}

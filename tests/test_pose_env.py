@@ -151,3 +151,27 @@ class TestPoseEnvEndToEnd:
     # One protocol line per checkpoint, each carrying success_rate.
     assert [r["step"] for r in records] == [2, 4]
     assert all("success_rate" in r for r in records)
+
+  def test_shipped_config_resolves_protocol_hook(self):
+    """The gin-bound SuccessEvalHook must RESOLVE, not just parse:
+    eval_fn is the real evaluate_pose_model and the kwargs carry the
+    500-episode BASELINE protocol."""
+    from tensor2robot_tpu import config as gin
+    import tensor2robot_tpu.train_eval  # noqa: F401
+    import tensor2robot_tpu.research.pose_env  # noqa: F401
+    import tensor2robot_tpu.hooks  # noqa: F401
+    import tensor2robot_tpu.data  # noqa: F401
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tensor2robot_tpu", "research", "pose_env", "configs",
+        "train_pose_env.gin")
+    gin.clear_config()
+    try:
+      gin.parse_config_files_and_bindings([path], [])
+      hooks = [h.resolve() for h in
+               gin.query_parameter("train_eval_model.hooks")]
+      assert hooks[0]._eval_fn is evaluate_pose_model
+      assert hooks[0]._eval_kwargs["num_episodes"] >= 500
+    finally:
+      gin.clear_config()

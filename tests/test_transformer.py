@@ -28,15 +28,15 @@ from tensor2robot_tpu.research.vrgripper import (
 )
 from tensor2robot_tpu.specs import TensorSpecStruct
 
-IMG = 16
+IMG = 24  # matches the per-step BC closed-loop test scale
 
 
 def tiny_model(**kwargs):
   kwargs.setdefault(
       "create_optimizer_fn",
-      lambda: opt_lib.create_optimizer(learning_rate=1e-3))
+      lambda: opt_lib.create_optimizer(learning_rate=3e-3))
   return VRGripperTransformerModel(
-      image_size=IMG, filters=(8,), embedding_size=16, width=32,
+      image_size=IMG, filters=(8, 16), embedding_size=32, width=48,
       depth=1, num_heads=2, max_context_length=64,
       attention_impl="reference", **kwargs)
 
@@ -111,19 +111,19 @@ class TestTransformerBC:
   def run(self, tmp_path_factory):
     root = tmp_path_factory.mktemp("tf_bc")
     data = collect_demo_episodes(
-        str(root / "demos.tfrecord"), num_episodes=48, image_size=IMG,
-        seed=0, action_noise=0.05)
+        str(root / "demos.tfrecord"), num_episodes=96, image_size=IMG,
+        seed=0, action_noise=0.1)
     model = tiny_model()
     model_dir = str(root / "model")
     train_eval.train_eval_model(
         model=model,
         model_dir=model_dir,
         input_generator_train=TFRecordEpisodeInputGenerator(
-            file_patterns=data, sequence_length=16, batch_size=8,
-            shuffle_buffer_size=48, seed=1),
-        max_train_steps=60,
+            file_patterns=data, sequence_length=16, batch_size=16,
+            shuffle_buffer_size=96, seed=1),
+        max_train_steps=400,
         batch_size=8,
-        save_checkpoints_steps=60,
+        save_checkpoints_steps=400,
         log_every_steps=10,
     )
     return model, model_dir
@@ -165,6 +165,28 @@ class TestTransformerBC:
       baselines.append(np.abs(target).mean())
     assert np.mean(errors) < 0.6 * np.mean(baselines), (
         np.mean(errors), np.mean(baselines))
+
+  def test_closed_loop_context_policy(self, run):
+    """Full-history policy drives the env: history accumulates, resets
+    at episode boundaries, and the clone closes the loop."""
+    from tensor2robot_tpu.research.vrgripper import (
+        evaluate_gripper_policy,
+    )
+    from tensor2robot_tpu.utils import checkpoints as ckpt_lib
+
+    model, model_dir = run
+    state = model.create_inference_state(jax.random.PRNGKey(0))
+    variables = ckpt_lib.restore_variables(
+        model_dir, like={"params": state.params,
+                         "batch_stats": state.batch_stats or {}})
+    state = state.replace(params=variables["params"])
+    policy = model.make_context_policy(state, context_length=16)
+    metrics = evaluate_gripper_policy(
+        policy, num_episodes=10, image_size=IMG, seed=33)
+    assert metrics["num_episodes"] == 10.0
+    # The scripted task is easy for a working clone; a broken history
+    # buffer (stale context, missing resets) tanks this immediately.
+    assert metrics["success_rate"] >= 0.4, metrics
 
   def test_masked_loss_ignores_padding(self):
     model = tiny_model()
