@@ -201,6 +201,41 @@ class TestGrasp2VecEndToEnd:
     mismatched = np.asarray(predictor.predict(batch)[GOAL_REWARD])
     assert matched.mean() > mismatched[keep].mean() + 0.2
 
+  def test_savedmodel_export_round_trip(self, run):
+    """jax2tf export serves the same embeddings as the checkpoint."""
+    from tensor2robot_tpu.export import SavedModelExportGenerator
+    from tensor2robot_tpu.predictors import SavedModelPredictor
+    from tensor2robot_tpu.utils import checkpoints as ckpt_lib
+
+    model, model_dir = run
+    state = model.create_inference_state(jax.random.PRNGKey(0))
+    variables = ckpt_lib.restore_variables(
+        model_dir, like={"params": state.params,
+                         "batch_stats": state.batch_stats or {}})
+    state = state.replace(params=variables["params"],
+                          batch_stats=variables["batch_stats"])
+    export_dir = SavedModelExportGenerator(
+        include_tf_example_signature=False).export(
+            model, jax.device_get(state), model_dir)
+    predictor = SavedModelPredictor(export_dir.rsplit("/", 1)[0])
+    assert predictor.restore(timeout_secs=0)
+
+    gen = GraspSceneGenerator(image_size=IMG,
+                              num_object_types=NUM_TYPES,
+                              num_distractors=1, seed=21)
+    triplets = [gen.sample() for _ in range(4)]
+    batch = {k: np.stack([t[k] for t in triplets])
+             for k in ("pregrasp_image", "postgrasp_image",
+                       "goal_image")}
+    exported = predictor.predict(batch)
+    checkpoint = CheckpointPredictor(model, checkpoint_dir=model_dir)
+    assert checkpoint.restore(timeout_secs=0)
+    native = checkpoint.predict(batch)
+    for key in (PREGRASP_EMBEDDING, GOAL_EMBEDDING, GOAL_REWARD):
+      np.testing.assert_allclose(
+          np.asarray(exported[key]), np.asarray(native[key]),
+          atol=2e-2, rtol=2e-2)
+
   def test_predict_outputs_complete(self, run):
     model, model_dir = run
     predictor = CheckpointPredictor(model, checkpoint_dir=model_dir)
