@@ -13,8 +13,7 @@ work).
 
 from __future__ import annotations
 
-import io
-from typing import Any, Dict, Optional
+from typing import Any, Dict
 
 import numpy as np
 

@@ -18,7 +18,7 @@ across them (train with ring on a pod, serve with flash on one chip).
 
 from __future__ import annotations
 
-from typing import Any, Optional, Sequence
+from typing import Any, Optional
 
 import flax.linen as nn
 import jax

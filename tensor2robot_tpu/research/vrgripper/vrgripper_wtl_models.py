@@ -29,7 +29,7 @@ serving convention as the MAML/SNAIL models.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional, Sequence, Tuple
+from typing import Any, Dict, Sequence, Tuple
 
 import flax.linen as nn
 import jax
@@ -39,7 +39,7 @@ import numpy as np
 from tensor2robot_tpu import config as gin
 from tensor2robot_tpu.data.abstract_input_generator import Mode
 from tensor2robot_tpu.layers import MLP
-from tensor2robot_tpu.layers.mdn import MDNHead, mdn_loss, mdn_mode
+from tensor2robot_tpu.layers.mdn import MDNHead, mdn_mode
 from tensor2robot_tpu.meta_learning.maml_model import (
     CONDITION,
     CONDITION_LABELS,
