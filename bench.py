@@ -227,7 +227,15 @@ def main():
     profile_dir = args[args.index("--profile") + 1]
   run_paper = "--paper" in args
 
-  detail = {"primary": bench_config(False, profile_dir=profile_dir)}
+  # Merge into any existing detail file: a plain run (the driver's)
+  # must not erase the --paper / --input records from a fuller run.
+  detail = {}
+  try:
+    with open("BENCH_DETAIL.json") as f:
+      detail = json.load(f)
+  except (OSError, ValueError):
+    pass
+  detail["primary"] = bench_config(False, profile_dir=profile_dir)
   if run_paper:
     detail["paper_scale"] = bench_config(True)
   if "--input" in args:
