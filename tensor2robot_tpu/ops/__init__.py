@@ -1,3 +1,4 @@
 """Pallas TPU kernels for the framework's hot ops."""
 
+from tensor2robot_tpu.ops.cem_head import fused_cem_head_tail
 from tensor2robot_tpu.ops.flash_attention import flash_attention

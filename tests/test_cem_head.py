@@ -1,0 +1,63 @@
+"""Fused CEM head-tail kernel: interpret-mode exactness vs the oracle."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from tensor2robot_tpu.ops import fused_cem_head_tail
+
+B, P, C, H, W, C1, C2 = 4, 64, 64, 8, 8, 64, 64
+
+
+def _params(seed=0):
+  rng = np.random.default_rng(seed)
+  f = lambda *s: jnp.asarray(  # noqa: E731
+      rng.standard_normal(s) * 0.3, jnp.bfloat16)
+  a1, enc0, v = f(B, P, C), f(B, H, W, C1), f(C, H, W, C1)
+  ck = f(3, 3, C1, C2)
+  bn_scale = f(C2).astype(jnp.float32)
+  bn_shift = f(C2).astype(jnp.float32)
+  dense = ((f(C2, 64), f(64)), (f(64, 64), f(64)), (f(64, 1), f(1)))
+  act = jax.lax.dot_general(
+      a1.reshape(B * P, C), v.reshape(C, -1),
+      (((1,), (0,)), ((), ())),
+      preferred_element_type=jnp.bfloat16).reshape(B, P, H, W, C1)
+  return act, enc0, ck, bn_scale, bn_shift, dense
+
+
+def _reference(act, enc0, ck, bn_scale, bn_shift, dense):
+  x = jax.nn.relu(act.astype(jnp.float32)
+                  + enc0.astype(jnp.float32)[:, None])
+  x = x.reshape(B * P, H, W, C1).astype(jnp.bfloat16)
+  y = jax.lax.conv_general_dilated(
+      x, ck, (2, 2), "SAME",
+      dimension_numbers=("NHWC", "HWIO", "NHWC"),
+      preferred_element_type=jnp.float32)
+  y = jax.nn.relu(y * bn_scale + bn_shift)
+  h = jnp.mean(y, axis=(1, 2)).astype(jnp.bfloat16)
+  for i, (w, b) in enumerate(dense):
+    h = jax.lax.dot_general(
+        h, w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) + b.astype(jnp.float32)
+    if i < len(dense) - 1:
+      h = jax.nn.relu(h).astype(jnp.bfloat16)
+  return h.reshape(B, P)
+
+
+class TestFusedCEMHeadTail:
+
+  def test_matches_xla_tail(self):
+    act, enc0, ck, bs, bsh, dense = _params()
+    ref = np.asarray(_reference(act, enc0, ck, bs, bsh, dense))
+    got = np.asarray(fused_cem_head_tail(
+        act, enc0, ck, bs, bsh, dense, interpret=True, block_b=2))
+    np.testing.assert_allclose(got, ref, atol=2e-3, rtol=2e-3)
+
+  def test_block_size_independence(self):
+    act, enc0, ck, bs, bsh, dense = _params(1)
+    outs = [np.asarray(fused_cem_head_tail(
+        act, enc0, ck, bs, bsh, dense, interpret=True, block_b=bb))
+        for bb in (1, 2, 4)]
+    np.testing.assert_allclose(outs[0], outs[1], atol=1e-5)
+    np.testing.assert_allclose(outs[0], outs[2], atol=1e-5)
