@@ -24,14 +24,15 @@ TPU).
 
 MEASURED OUTCOME (v5e, bench primary config): the fused kernel runs
 the tail in 3.09 ms vs 1.12 ms for the tuned XLA P-major formulation
-in `GraspingQNetwork.score_population` — the network's 64-wide
-channels cap every tap GEMM at a quarter of the 128×128 MXU, a bound
-the XLA path already sits near, and the kernel's per-state loop +
-plane-shift copies cost more than the HBM round trips they save at
-this arithmetic intensity. The production path therefore stays XLA;
-this kernel is kept as the measured baseline for revisiting if the
-Q-network grows MXU-width channels (≥128), where the fusion math
-flips. Negative results are results; see docs/PARALLELISM.md.
+in `GraspingQNetwork.score_population` (3.84 vs 1.29 ms at 128-wide
+channels — width doesn't flip it). The kernel's per-state loop,
+9 sequential tap GEMMs, and plane-shift copies cost more than the HBM
+round trips they save; XLA's fused conv pipeline is simply the better
+schedule at this arithmetic intensity. The production path therefore
+stays XLA; this kernel is kept as the measured, numerics-verified
+baseline and as the repo's worked example of the parity-plane conv
+trick under Mosaic's lane-dim constraints. Negative results are
+results.
 """
 
 from __future__ import annotations
