@@ -229,6 +229,38 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 @functools.partial(
     jax.jit, static_argnames=("causal", "block_q", "block_k",
                               "interpret"))
+def flash_attention_with_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+  """Like `flash_attention` but also returns the logsumexp.
+
+  Returns (out [B, T, H, D], lse [B, H, T]). The lse makes attention
+  COMPOSABLE: partial attentions over disjoint key sets combine
+  exactly as out = Σ_s softmax_s(lse_s) · out_s — which is how ring
+  attention runs this kernel per device and merges blocks arriving
+  over the ICI ring. Forward-only (no custom VJP on this entry).
+  """
+  b, t, h, d = q.shape
+  block_q = min(block_q, t)
+  block_k = min(block_k, t)
+  if t % block_q or t % block_k:
+    raise ValueError(
+        f"Sequence length {t} must divide block sizes "
+        f"({block_q}, {block_k}).")
+  out, lse = _flash_forward_impl(q, k, v, causal, block_q, block_k,
+                                 interpret)
+  return out, lse.reshape(b, h, t)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k",
+                              "interpret"))
 def flash_attention(
     q: jax.Array,
     k: jax.Array,

@@ -120,6 +120,43 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
   return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
 
+def _ring_attention_local_flash(q, k, v, axis_name: str, causal: bool,
+                                ring_size: int, interpret: bool):
+  """Per-device ring body running the PALLAS kernel on each block.
+
+  The composition insight: with the ring statically unrolled, step 0
+  is exactly the causal DIAGONAL block (q and k are the same local
+  slice, so the kernel's in-call causal mask is the right mask), and
+  every later step is either fully attended (source block in the
+  past) or fully excluded (future) — a per-device SCALAR decision
+  that a logsumexp weight handles, no in-kernel dynamic masking
+  needed. Partial outputs combine exactly via their logsumexps.
+  """
+  from tensor2robot_tpu.ops.flash_attention import (
+      flash_attention_with_lse,
+  )
+
+  idx = jax.lax.axis_index(axis_name)
+  perm = [(j, (j - 1) % ring_size) for j in range(ring_size)]
+  outs, lses = [], []
+  for s in range(ring_size):
+    o_s, lse_s = flash_attention_with_lse(
+        q, k, v, causal=(causal and s == 0), interpret=interpret)
+    if causal and s > 0:
+      src = (idx + s) % ring_size
+      lse_s = jnp.where(src < idx, lse_s, _NEG_INF)
+    outs.append(o_s)
+    lses.append(lse_s)
+    if s < ring_size - 1:
+      k = jax.lax.ppermute(k, axis_name, perm)
+      v = jax.lax.ppermute(v, axis_name, perm)
+  lse = jnp.stack(lses)                      # [S, B, H, Tq]
+  weights = jax.nn.softmax(lse, axis=0)      # exact partial combine
+  out = jnp.einsum("sbht,sbthd->bthd", weights,
+                   jnp.stack(outs).astype(jnp.float32))
+  return out.astype(q.dtype)
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -128,6 +165,8 @@ def ring_attention(
     axis_name: str = SEQ_AXIS,
     causal: bool = False,
     shard_batch: bool = True,
+    block_impl: str = "reference",
+    flash_interpret: bool = False,
 ) -> jax.Array:
   """Exact attention with the sequence dim sharded over `axis_name`.
 
@@ -139,6 +178,11 @@ def ring_attention(
     causal: causal masking by global position.
     shard_batch: also shard B over the `data` axis when the mesh has
       one (the standard data × sequence 2D layout).
+    block_impl: per-device block math — "reference" (jnp online
+      softmax) or "flash" (the Pallas kernel per block, partials
+      combined by logsumexp; the long-context production path on TPU).
+    flash_interpret: run the kernel in the pallas interpreter (CPU
+      tests).
 
   Returns [B, T, H, D], sharded like q.
   """
@@ -153,8 +197,16 @@ def ring_attention(
   batch_axis = (DATA_AXIS if shard_batch
                 and DATA_AXIS in mesh.axis_names else None)
   spec = P(batch_axis, axis_name, None, None)
-  local = functools.partial(_ring_attention_local, axis_name=axis_name,
-                            causal=causal)
+  if block_impl == "flash":
+    local = functools.partial(
+        _ring_attention_local_flash, axis_name=axis_name,
+        causal=causal, ring_size=mesh.shape[axis_name],
+        interpret=flash_interpret)
+  elif block_impl == "reference":
+    local = functools.partial(
+        _ring_attention_local, axis_name=axis_name, causal=causal)
+  else:
+    raise ValueError(f"Unknown block_impl: {block_impl!r}")
   fn = jax.shard_map(
       lambda q, k, v: local(q, k, v),
       mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,

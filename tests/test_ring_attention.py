@@ -89,6 +89,20 @@ class TestRingAttention:
       np.testing.assert_allclose(np.asarray(rg), np.asarray(eg),
                                  atol=5e-4, rtol=5e-4)
 
+  @pytest.mark.parametrize("causal", [False, True])
+  def test_flash_blocks_match_reference(self, causal):
+    """ring(flash per-device blocks) == full attention: the pallas
+    kernel's partials combine exactly via logsumexp across the ring."""
+    q, k, v = _qkv(6)
+    mesh = create_mesh({SEQ_AXIS: 8})
+    expected = attention_reference(q, k, v, causal=causal)
+    sharding = sequence_sharding(mesh)
+    args = [jax.device_put(x, sharding) for x in (q, k, v)]
+    got = ring_attention(*args, mesh=mesh, causal=causal,
+                         block_impl="flash", flash_interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               atol=5e-5, rtol=5e-5)
+
   def test_jits_under_mesh(self):
     q, k, v = _qkv(4)
     mesh = create_mesh({SEQ_AXIS: 8})
