@@ -33,13 +33,17 @@ def _attend(q, k, v, *, impl: str, causal: bool, mesh) -> jax.Array:
       ring_attention,
   )
 
+  on_tpu = jax.devices()[0].platform == "tpu"
   if impl == "auto":
-    on_tpu = jax.devices()[0].platform == "tpu"
     impl = "flash" if on_tpu else "reference"
   if impl == "flash":
     return flash_attention(q, k, v, causal=causal)
   if impl == "ring":
-    return ring_attention(q, k, v, mesh=mesh, causal=causal)
+    # On TPU the ring runs the flash kernel within each chip
+    # (partials combined by logsumexp over the ICI ring).
+    return ring_attention(q, k, v, mesh=mesh, causal=causal,
+                          block_impl="flash" if on_tpu
+                          else "reference")
   if impl == "reference":
     return attention_reference(q, k, v, causal=causal)
   raise ValueError(f"Unknown attention impl: {impl!r}")
