@@ -9,11 +9,16 @@ makes first-class: the same module scales from short demo episodes to
   * "reference": materialized softmax attention (CPU tests, short T),
   * "flash": the Pallas O(T)-memory kernel (`ops/flash_attention.py`),
   * "ring": sequence-parallel across chips
-    (`parallel/ring_attention.py`, pass `mesh`),
+    (`parallel/ring_attention.py`; requires `mesh`). On TPU the
+    per-device blocks run the flash kernel, whose lse output is
+    differentiable — training through the ring works,
+  * "ring_flash": the ring with flash blocks forced on (interpret
+    mode off-TPU) — the CPU-testable spelling of the TPU ring path,
   * "auto": flash on TPU, reference elsewhere.
 
-All backends compute EXACT attention, so checkpoints are portable
-across them (train with ring on a pod, serve with flash on one chip).
+All backends compute EXACT attention in forward AND backward, so
+checkpoints are portable across them (train with ring on a pod, serve
+with flash on one chip).
 """
 
 from __future__ import annotations
@@ -38,12 +43,21 @@ def _attend(q, k, v, *, impl: str, causal: bool, mesh) -> jax.Array:
     impl = "flash" if on_tpu else "reference"
   if impl == "flash":
     return flash_attention(q, k, v, causal=causal)
-  if impl == "ring":
+  if impl in ("ring", "ring_flash"):
+    if mesh is None:
+      raise ValueError(
+          f"attention_impl={impl!r} needs a device mesh with a "
+          "'seq' axis; pass mesh= (models: the mesh constructor "
+          "argument) or use 'flash'/'reference' single-device.")
     # On TPU the ring runs the flash kernel within each chip
-    # (partials combined by logsumexp over the ICI ring).
+    # (partials combined by logsumexp over the ICI ring);
+    # "ring_flash" forces that composition off-TPU too, via the
+    # pallas interpreter — how CPU tests cover the production path.
+    use_flash = on_tpu or impl == "ring_flash"
     return ring_attention(q, k, v, mesh=mesh, causal=causal,
-                          block_impl="flash" if on_tpu
-                          else "reference")
+                          block_impl="flash" if use_flash
+                          else "reference",
+                          flash_interpret=use_flash and not on_tpu)
   if impl == "reference":
     return attention_reference(q, k, v, causal=causal)
   raise ValueError(f"Unknown attention impl: {impl!r}")
@@ -122,6 +136,12 @@ class CausalTransformer(nn.Module):
     b, t, _ = x.shape
     if t > self.max_len:
       raise ValueError(f"sequence length {t} > max_len {self.max_len}")
+    if self.width % self.num_heads:
+      raise ValueError(
+          f"width {self.width} must divide evenly into "
+          f"{self.num_heads} heads (got remainder "
+          f"{self.width % self.num_heads}); attention would silently "
+          "run at reduced capacity otherwise.")
     head_dim = self.width // self.num_heads
     x = nn.Dense(self.width, dtype=self.dtype, name="embed")(
         x.astype(self.dtype))

@@ -184,7 +184,8 @@ class AbstractT2RModel(ModelInterface):
     # from real batches, so init must see the same tree structure or the
     # first jitted step diverges from the initialized params.
     dummy = specs_lib.make_random_tensors(
-        out_spec, batch_size=batch_size, seed=0, include_optional=False)
+        out_spec, batch_size=batch_size, seed=0, include_optional=False,
+        sequence_length=self.init_sequence_length)
     dummy = jax.tree_util.tree_map(jnp.asarray, dummy)
     init_rng, dropout_rng = jax.random.split(rng)
     variables = self.network.init(
@@ -200,6 +201,16 @@ class AbstractT2RModel(ModelInterface):
         batch_stats=batch_stats,
         opt_state=None,
     )
+
+  @property
+  def init_sequence_length(self):
+    """Time-axis length of the dummy init batch for sequence specs.
+
+    None → the random-data default. Models whose networks constrain T
+    (e.g. sequence-parallel attention needs T divisible by the mesh's
+    `seq` axis) override this so initialization traces a valid shape.
+    """
+    return None
 
   def create_train_state(self, rng: jax.Array,
                          batch_size: int = 1) -> TrainState:

@@ -133,29 +133,21 @@ def _flash_forward_impl(q, k, v, causal: bool, block_q: int,
   return out.reshape(b, h, t, d).transpose(0, 2, 1, 3), lse[..., 0]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, block_q, block_k, interpret):
-  out, _ = _flash_forward_impl(q, k, v, causal, block_q, block_k,
-                               interpret)
-  return out
-
-
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-  out, lse = _flash_forward_impl(q, k, v, causal, block_q, block_k,
-                                 interpret)
-  return out, (q, k, v, out, lse)
-
-
-def _flash_bwd(causal, block_q, block_k, interpret, residuals, do):
+def _flash_bwd_core(q, k, v, out, lse, do, dlse, causal, block_q,
+                    block_k):
   """Standard flash backward, double-scanned over (q, k) blocks.
 
   Recomputes each [block_q, block_k] score tile from q/k + the saved
   logsumexp; no [T, T] tensor is ever materialized, so the backward is
   O(T) memory like the forward. Runs as plain XLA (f32 accumulation);
   a dedicated pallas backward kernel is a future optimization.
+
+  `dlse` ([BH, T]) is the cotangent of the logsumexp output — zeros
+  when the caller only used `out`: since ∂lse_i/∂s_ij = p_ij, it
+  folds into the softmax-jacobian diagonal as ds = p·(dp − (δ − g)) —
+  one subtraction, which is what makes the lse-composed ring
+  attention trainable through this kernel.
   """
-  del interpret
-  q, k, v, out, lse = residuals
   b, t, h, d = q.shape
   scale = 1.0 / np.sqrt(d)
   nq, nk = t // block_q, t // block_k
@@ -170,6 +162,7 @@ def _flash_bwd(causal, block_q, block_k, interpret, residuals, do):
   o_f = fold(out).astype(jnp.float32)
   # D_i = rowsum(dO * O): the softmax-jacobian diagonal correction.
   delta = jnp.sum(do_f * o_f, axis=-1)  # [BH, T]
+  delta = delta - dlse.astype(jnp.float32)
 
   q_b = q_f.reshape(b * h, nq, block_q, d)
   do_b = do_f.reshape(b * h, nq, block_q, d)
@@ -223,7 +216,28 @@ def _flash_bwd(causal, block_q, block_k, interpret, residuals, do):
   return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-_flash.defvjp(_flash_fwd, _flash_bwd)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_lse(q, k, v, causal, block_q, block_k, interpret):
+  return _flash_forward_impl(q, k, v, causal, block_q, block_k,
+                             interpret)
+
+
+def _flash_lse_fwd(q, k, v, causal, block_q, block_k, interpret):
+  out, lse = _flash_forward_impl(q, k, v, causal, block_q, block_k,
+                                 interpret)
+  return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_lse_bwd(causal, block_q, block_k, interpret, residuals,
+                   cotangents):
+  del interpret
+  q, k, v, out, lse = residuals
+  do, dlse = cotangents
+  return _flash_bwd_core(q, k, v, out, lse, do, dlse, causal, block_q,
+                         block_k)
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
 @functools.partial(
@@ -244,7 +258,10 @@ def flash_attention_with_lse(
   COMPOSABLE: partial attentions over disjoint key sets combine
   exactly as out = Σ_s softmax_s(lse_s) · out_s — which is how ring
   attention runs this kernel per device and merges blocks arriving
-  over the ICI ring. Forward-only (no custom VJP on this entry).
+  over the ICI ring. Differentiable in BOTH outputs: the custom VJP
+  folds the lse cotangent into the softmax-jacobian diagonal
+  (∂lse/∂s = p), so `jax.grad` through an lse-weighted combine — the
+  ring's merge — is exact.
   """
   b, t, h, d = q.shape
   block_q = min(block_q, t)
@@ -253,8 +270,7 @@ def flash_attention_with_lse(
     raise ValueError(
         f"Sequence length {t} must divide block sizes "
         f"({block_q}, {block_k}).")
-  out, lse = _flash_forward_impl(q, k, v, causal, block_q, block_k,
-                                 interpret)
+  out, lse = _flash_lse(q, k, v, causal, block_q, block_k, interpret)
   return out, lse.reshape(b, h, t)
 
 
@@ -275,7 +291,9 @@ def flash_attention(
   T must divide by the block sizes (pad upstream — robot episode and
   context lengths are static in this framework by construction).
   Differentiable via the flash custom VJP (logsumexp residual +
-  blockwise recompute).
+  blockwise recompute); shares `_flash_lse`'s backward — the dropped
+  lse output contributes a zero cotangent, so there is exactly ONE
+  backward implementation to keep correct.
   """
   b, t, h, d = q.shape
   block_q = min(block_q, t)
@@ -284,4 +302,5 @@ def flash_attention(
     raise ValueError(
         f"Sequence length {t} must divide block sizes "
         f"({block_q}, {block_k}).")
-  return _flash(q, k, v, causal, block_q, block_k, interpret)
+  out, _ = _flash_lse(q, k, v, causal, block_q, block_k, interpret)
+  return out

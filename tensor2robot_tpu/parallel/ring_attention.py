@@ -128,9 +128,13 @@ def _ring_attention_local_flash(q, k, v, axis_name: str, causal: bool,
   is exactly the causal DIAGONAL block (q and k are the same local
   slice, so the kernel's in-call causal mask is the right mask), and
   every later step is either fully attended (source block in the
-  past) or fully excluded (future) — a per-device SCALAR decision
-  that a logsumexp weight handles, no in-kernel dynamic masking
-  needed. Partial outputs combine exactly via their logsumexps.
+  past) or fully excluded (future) — a per-device SCALAR decision,
+  so excluded steps skip the kernel entirely under `lax.cond`
+  (halving the causal per-device FLOPs) and contribute lse = -inf.
+  Partial outputs combine exactly via their logsumexps; because the
+  kernel's lse output is differentiable, `jax.grad` flows through
+  the whole ring (cond branches, ppermute rotations and the
+  softmax-weighted merge are all standard differentiable JAX).
   """
   from tensor2robot_tpu.ops.flash_attention import (
       flash_attention_with_lse,
@@ -138,13 +142,28 @@ def _ring_attention_local_flash(q, k, v, axis_name: str, causal: bool,
 
   idx = jax.lax.axis_index(axis_name)
   perm = [(j, (j - 1) % ring_size) for j in range(ring_size)]
+  batch, t_local, heads, _ = q.shape
+
+  def attend(qq, kk, vv, block_causal):
+    return flash_attention_with_lse(
+        qq, kk, vv, causal=block_causal, interpret=interpret)
+
+  def skip(qq, kk, vv):
+    del kk, vv
+    return (jnp.zeros_like(qq),
+            jnp.full((batch, heads, t_local), _NEG_INF, jnp.float32))
+
   outs, lses = [], []
   for s in range(ring_size):
-    o_s, lse_s = flash_attention_with_lse(
-        q, k, v, causal=(causal and s == 0), interpret=interpret)
     if causal and s > 0:
+      # Blocks from the future (src > idx) are fully excluded: skip
+      # the kernel — the ppermute still rotates K/V through.
       src = (idx + s) % ring_size
-      lse_s = jnp.where(src < idx, lse_s, _NEG_INF)
+      o_s, lse_s = jax.lax.cond(
+          src < idx, functools.partial(attend, block_causal=False),
+          skip, q, k, v)
+    else:
+      o_s, lse_s = attend(q, k, v, block_causal=(causal and s == 0))
     outs.append(o_s)
     lses.append(lse_s)
     if s < ring_size - 1:
@@ -194,8 +213,12 @@ def ring_attention(
         f"Sequence length {q.shape[1]} must divide the {axis_name!r} "
         f"axis size {mesh.shape[axis_name]}.")
 
+  # Shard B over `data` only when it divides: trace-time batches (a
+  # model init's B=1 dummy) replicate instead of failing deep inside
+  # shard_map; real training batches are data-divisible by layout.
   batch_axis = (DATA_AXIS if shard_batch
-                and DATA_AXIS in mesh.axis_names else None)
+                and DATA_AXIS in mesh.axis_names
+                and q.shape[0] % mesh.shape[DATA_AXIS] == 0 else None)
   spec = P(batch_axis, axis_name, None, None)
   if block_impl == "flash":
     local = functools.partial(

@@ -52,6 +52,7 @@ class _EpisodeTransformerNet(nn.Module):
   num_heads: int
   max_len: int
   attention_impl: str
+  mesh: Optional[Any] = None
   dtype: Any = jnp.bfloat16
 
   @nn.compact
@@ -75,7 +76,8 @@ class _EpisodeTransformerNet(nn.Module):
     trunk = CausalTransformer(
         width=self.width, depth=self.depth, num_heads=self.num_heads,
         max_len=self.max_len, attention_impl=self.attention_impl,
-        causal=True, dtype=self.dtype, name="trunk")(emb, train=train)
+        causal=True, mesh=self.mesh, dtype=self.dtype,
+        name="trunk")(emb, train=train)
     action = nn.Dense(self.action_dim, dtype=self.dtype,
                       name="action_head")(
         trunk.astype(self.dtype)).astype(jnp.float32)
@@ -97,8 +99,12 @@ class VRGripperTransformerModel(AbstractT2RModel):
                num_heads: int = 4,
                max_context_length: int = 512,
                attention_impl: str = "auto",
+               mesh: Optional[Any] = None,
                device_dtype=jnp.bfloat16,
                **kwargs):
+    """`mesh`: required for attention_impl="ring"/"ring_flash" — the
+    device mesh whose `seq` axis the episode dimension shards over
+    (sequence parallelism); unused by single-device backends."""
     super().__init__(device_dtype=device_dtype, **kwargs)
     self._image_size = image_size
     self._state_dim = state_dim
@@ -110,6 +116,26 @@ class VRGripperTransformerModel(AbstractT2RModel):
     self._num_heads = num_heads
     self._max_len = max_context_length
     self._attention_impl = attention_impl
+    self._mesh = mesh
+    if mesh is not None:
+      from tensor2robot_tpu.parallel.mesh import SEQ_AXIS
+      if (SEQ_AXIS in mesh.axis_names
+          and max_context_length % mesh.shape[SEQ_AXIS]):
+        raise ValueError(
+            f"max_context_length={max_context_length} must be a "
+            f"multiple of the mesh's {SEQ_AXIS!r} axis size "
+            f"{mesh.shape[SEQ_AXIS]} for sequence parallelism.")
+
+  @property
+  def init_sequence_length(self):
+    """Sequence-parallel attention needs init T divisible by the
+    mesh's `seq` axis; single-device backends keep the default."""
+    if self._mesh is not None:
+      from tensor2robot_tpu.parallel.mesh import SEQ_AXIS
+      if SEQ_AXIS in self._mesh.axis_names:
+        # Valid by the constructor check: max_len % seq_size == 0.
+        return self._mesh.shape[SEQ_AXIS]
+    return None
 
   def get_feature_specification(self, mode: Mode) -> TensorSpecStruct:
     st = TensorSpecStruct()
@@ -142,6 +168,7 @@ class VRGripperTransformerModel(AbstractT2RModel):
         num_heads=self._num_heads,
         max_len=self._max_len,
         attention_impl=self._attention_impl,
+        mesh=self._mesh,
         dtype=self.device_dtype,
     )
 

@@ -70,6 +70,44 @@ class TestFlashAttention:
       np.testing.assert_allclose(np.asarray(g), np.asarray(w),
                                  atol=5e-5, rtol=5e-5)
 
+  @pytest.mark.parametrize("causal", [False, True])
+  def test_lse_gradients_match_reference(self, causal):
+    """Both outputs of `flash_attention_with_lse` carry gradients.
+
+    The lse cotangent folds into the softmax-jacobian diagonal
+    (∂lse/∂s = p); the oracle is autodiff through a materialized
+    softmax + logsumexp. This is what makes the lse-weighted ring
+    combine trainable.
+    """
+    from tensor2robot_tpu.ops.flash_attention import (
+        flash_attention_with_lse,
+    )
+    q, k, v = _qkv(7)
+    scale = 1.0 / np.sqrt(D)
+
+    def flash_loss(q, k, v):
+      out, lse = flash_attention_with_lse(
+          q, k, v, causal=causal, block_q=64, block_k=64,
+          interpret=True)
+      # A loss using BOTH outputs, so both cotangents are nonzero.
+      return jnp.sum(out ** 2) + jnp.sum(jnp.sin(lse))
+
+    def ref_loss(q, k, v):
+      s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+      if causal:
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+      lse = jax.scipy.special.logsumexp(s, axis=-1)  # [B, H, T]
+      p = jnp.exp(s - lse[..., None])
+      out = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+      return jnp.sum(out ** 2) + jnp.sum(jnp.sin(lse))
+
+    got = jax.grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for g, w in zip(got, want):
+      np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                 atol=5e-5, rtol=5e-5)
+
   def test_matches_ring_attention_math(self):
     """Within-chip tiling and across-chip ring agree (same algorithm)."""
     from tensor2robot_tpu.parallel import (

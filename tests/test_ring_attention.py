@@ -103,6 +103,34 @@ class TestRingAttention:
     np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
                                atol=5e-5, rtol=5e-5)
 
+  @pytest.mark.parametrize("causal", [False, True])
+  def test_flash_block_gradients_match(self, causal):
+    """jax.grad through ring(flash blocks) == reference autodiff.
+
+    This is the TPU production training path: the pallas kernel's
+    (out, lse) custom VJP composes with the lse-softmax merge, the
+    lax.cond block-skip, and the ppermute rotations. Forward-only
+    until round 4 — this test pins the backward."""
+    q, k, v = _qkv(7)
+    mesh = create_mesh({SEQ_AXIS: 8})
+    sharding = sequence_sharding(mesh)
+
+    def ring_loss(q, k, v):
+      return jnp.sum(
+          ring_attention(q, k, v, mesh=mesh, causal=causal,
+                         block_impl="flash",
+                         flash_interpret=True) ** 2)
+
+    def ref_loss(q, k, v):
+      return jnp.sum(attention_reference(q, k, v, causal=causal) ** 2)
+
+    args = [jax.device_put(x, sharding) for x in (q, k, v)]
+    ring_grads = jax.grad(ring_loss, argnums=(0, 1, 2))(*args)
+    ref_grads = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for rg, eg in zip(ring_grads, ref_grads):
+      np.testing.assert_allclose(np.asarray(rg), np.asarray(eg),
+                                 atol=5e-4, rtol=5e-4)
+
   def test_jits_under_mesh(self):
     q, k, v = _qkv(4)
     mesh = create_mesh({SEQ_AXIS: 8})
