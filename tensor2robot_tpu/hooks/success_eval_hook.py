@@ -126,13 +126,9 @@ class QTOptSuccessEvalHook(Hook):
     from tensor2robot_tpu.research.qtopt.grasping_env import (
         evaluate_grasp_policy,
     )
-    from tensor2robot_tpu.research.qtopt.qtopt_learner import (
-        QTOptState,
-    )
 
-    learner_state = (state if isinstance(state, QTOptState)
-                     else QTOptState(train_state=state,
-                                     target_params=None))
-    metrics = evaluate_grasp_policy(self._learner, learner_state,
+    # build_policy accepts the critic TrainState directly — no need
+    # to fabricate a QTOptState with dummy target params.
+    metrics = evaluate_grasp_policy(self._learner, state,
                                     **self._eval_kwargs)
     _write_metrics(model_dir, self._tag, step, metrics)

@@ -213,12 +213,21 @@ def ring_attention(
         f"Sequence length {q.shape[1]} must divide the {axis_name!r} "
         f"axis size {mesh.shape[axis_name]}.")
 
-  # Shard B over `data` only when it divides: trace-time batches (a
-  # model init's B=1 dummy) replicate instead of failing deep inside
-  # shard_map; real training batches are data-divisible by layout.
-  batch_axis = (DATA_AXIS if shard_batch
-                and DATA_AXIS in mesh.axis_names
-                and q.shape[0] % mesh.shape[DATA_AXIS] == 0 else None)
+  # B shards over `data` when it divides. B == 1 (a model init's dummy
+  # batch, single-example serving) replicates instead of failing deep
+  # inside shard_map. Any other non-divisible B is a real layout bug —
+  # silently replicating would multiply FLOPs/memory by the axis size —
+  # so it stays a loud error.
+  batch_axis = None
+  if shard_batch and DATA_AXIS in mesh.axis_names:
+    data_size = mesh.shape[DATA_AXIS]
+    if q.shape[0] % data_size == 0:
+      batch_axis = DATA_AXIS
+    elif q.shape[0] != 1:
+      raise ValueError(
+          f"Batch {q.shape[0]} does not divide the {DATA_AXIS!r} axis "
+          f"size {data_size}; pass shard_batch=False to replicate "
+          "deliberately.")
   spec = P(batch_axis, axis_name, None, None)
   if block_impl == "flash":
     local = functools.partial(

@@ -174,13 +174,20 @@ class QTOptLearner:
 
     The serving-side CEM: the reference's robots looped predict() calls
     host-side; here action selection is one device program.
+
+    `state` may be the full learner `QTOptState` OR just the critic
+    `TrainState`: acting reads only the online params (the target net
+    exists for Bellman backups, never for action selection), so
+    serving contexts that hold a bare TrainState — checkpoint hooks,
+    exported policies — pass it directly instead of fabricating a
+    learner state with dummy targets.
     """
     population = cem_population or self._cem_population
     iterations = cem_iterations or self._cem_iterations
 
-    def policy(state: QTOptState, observations: TensorSpecStruct,
+    def policy(state, observations: TensorSpecStruct,
                rng: jax.Array) -> jax.Array:
-      ts = state.train_state
+      ts = state.train_state if isinstance(state, QTOptState) else state
       variables = {"params": ts.params}
       if ts.batch_stats:
         variables["batch_stats"] = ts.batch_stats

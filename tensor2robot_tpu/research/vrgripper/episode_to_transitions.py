@@ -15,6 +15,7 @@ static-shaped for XLA.
 
 from __future__ import annotations
 
+import warnings
 from typing import Iterator, Optional, Tuple
 
 import numpy as np
@@ -47,8 +48,10 @@ def episode_batch_to_transitions(
       When given, the time axis comes from a sequence key and context vs
       sequence classification is exact. When None, the time axis falls
       back to the first rank>=2 value — ambiguous if a [B, D] context
-      key precedes every sequence key, so spec-aware callers should
-      always pass it.
+      key precedes every sequence key — and a RuntimeWarning fires so
+      the guess never goes unnoticed. Spec-aware callers (derive the
+      set from `get_feature_specification(...).is_sequence`, as
+      `TransitionInputGenerator` does) should always pass it.
   """
   flat_f = features.to_flat_dict()
   lengths = flat_f.pop(SEQUENCE_LENGTH_KEY, None)
@@ -60,8 +63,22 @@ def episode_batch_to_transitions(
       anchor = next((v for k, v in labels.to_flat_dict().items()
                      if k in sequence_keys), None)
   if anchor is None:
-    anchor = next((v for v in flat_f.values() if v.ndim >= 2),
-                  next(iter(flat_f.values())))
+    anchor_key, anchor = next(
+        ((k, v) for k, v in flat_f.items() if v.ndim >= 2),
+        next(iter(flat_f.items())))
+    if sequence_keys:
+      reason = (f"sequence_keys={sorted(sequence_keys)!r} matched no "
+                f"feature/label key (present: {sorted(flat_f)!r}) — "
+                "likely a flat-name mismatch")
+    else:
+      reason = "called without sequence_keys"
+    warnings.warn(
+        f"episode_batch_to_transitions {reason}: guessing the time "
+        f"axis from {anchor_key!r} (first rank>=2 value). A [B, D] "
+        "per-episode context key ahead of the sequence keys makes "
+        "this guess WRONG silently — pass sequence_keys derived from "
+        "the model's specs (spec.is_sequence).",
+        RuntimeWarning, stacklevel=2)
   batch, time = anchor.shape[0], anchor.shape[1] if anchor.ndim > 1 else 1
   if lengths is None:
     mask = np.ones((batch, time), bool)

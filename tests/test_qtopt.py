@@ -230,6 +230,11 @@ class TestQTOptLearner:
     action = policy(state, obs, RNG)
     assert action.shape == (3, 2)
     assert float(jnp.max(jnp.abs(action))) <= 1.0 + 1e-6
+    # Serving contexts hold only the critic TrainState (no target
+    # net); the policy must accept it directly and act identically.
+    action_ts = policy(state.train_state, obs, RNG)
+    np.testing.assert_array_equal(np.asarray(action),
+                                  np.asarray(action_ts))
 
   def test_learner_learns_synthetic_bandit(self):
     """Reward = 1 iff action ~ fixed target: Q must rank it higher."""

@@ -154,7 +154,8 @@ class TestEpisodeToTransitions:
         "sequence_length": np.array([3, 5], np.int32)})
     labels = TensorSpecStruct.from_flat_dict({
         "a": np.ones((2, 6, 1), np.float32)})
-    f, l = episode_batch_to_transitions(features, labels)
+    f, l = episode_batch_to_transitions(
+        features, labels, sequence_keys=frozenset({"x", "a"}))
     assert f["x"].shape == (8, 2)  # 3 + 5 real steps
     assert l["a"].shape == (8, 1)
     np.testing.assert_array_equal(f["x"][:3],
@@ -164,9 +165,25 @@ class TestEpisodeToTransitions:
     features = TensorSpecStruct.from_flat_dict({
         "x": np.zeros((2, 3, 2), np.float32),
         "task": np.array([[1.0], [2.0]], np.float32)})
-    f, _ = episode_batch_to_transitions(features, None)
+    f, _ = episode_batch_to_transitions(
+        features, None, sequence_keys=frozenset({"x"}))
     np.testing.assert_array_equal(f["task"].reshape(-1),
                                   [1, 1, 1, 2, 2, 2])
+
+  def test_missing_sequence_keys_warns(self):
+    """The rank-heuristic time-axis fallback must be loud: a [B, D]
+    context key ahead of the sequence keys silently flips the guess."""
+    import warnings as warnings_lib
+
+    features = TensorSpecStruct.from_flat_dict({
+        "x": np.zeros((2, 3, 2), np.float32)})
+    with pytest.warns(RuntimeWarning, match="sequence_keys"):
+      episode_batch_to_transitions(features, None)
+    # Spec-derived keys: silent.
+    with warnings_lib.catch_warnings():
+      warnings_lib.simplefilter("error")
+      episode_batch_to_transitions(
+          features, None, sequence_keys=frozenset({"x"}))
 
   def test_generator_rebatches(self, tmp_path):
     path = str(tmp_path / "demos.tfrecord")
