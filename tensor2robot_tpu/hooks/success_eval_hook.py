@@ -100,8 +100,9 @@ class QTOptSuccessEvalHook(Hook):
   """CEM-policy grasp success per checkpoint (QT-Opt loop).
 
   `train_qtopt` hands hooks the critic TrainState; the CEM policy
-  reads exactly that (the target net never acts), so the hook rebuilds
-  the learner-state shim and calls `evaluate_grasp_policy`.
+  reads exactly that (the target net never acts), so the hook passes
+  the state straight to `evaluate_grasp_policy` — `build_policy`
+  accepts a bare TrainState.
   """
 
   def __init__(self,

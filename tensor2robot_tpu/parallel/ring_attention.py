@@ -29,6 +29,7 @@ same math single-device, so models call one function everywhere.
 from __future__ import annotations
 
 import functools
+import warnings
 from typing import Optional
 
 import jax
@@ -213,21 +214,26 @@ def ring_attention(
         f"Sequence length {q.shape[1]} must divide the {axis_name!r} "
         f"axis size {mesh.shape[axis_name]}.")
 
-  # B shards over `data` when it divides. B == 1 (a model init's dummy
-  # batch, single-example serving) replicates instead of failing deep
-  # inside shard_map. Any other non-divisible B is a real layout bug —
-  # silently replicating would multiply FLOPs/memory by the axis size —
-  # so it stays a loud error.
+  # B shards over `data` when it divides; otherwise it replicates so
+  # the function still serves any batch. B == 1 (a model init's dummy
+  # batch, single-example serving) replicates silently — that's the
+  # designed path. Any other non-divisible B warns: training batches
+  # are divisibility-enforced upstream (`mesh.local_batch_size`), so
+  # hitting this in a train loop means the layout is wrong and every
+  # data row is burning axis_size× the FLOPs.
   batch_axis = None
   if shard_batch and DATA_AXIS in mesh.axis_names:
     data_size = mesh.shape[DATA_AXIS]
     if q.shape[0] % data_size == 0:
       batch_axis = DATA_AXIS
     elif q.shape[0] != 1:
-      raise ValueError(
-          f"Batch {q.shape[0]} does not divide the {DATA_AXIS!r} axis "
-          f"size {data_size}; pass shard_batch=False to replicate "
-          "deliberately.")
+      warnings.warn(
+          f"ring_attention: batch {q.shape[0]} does not divide the "
+          f"{DATA_AXIS!r} axis size {data_size}; replicating the "
+          "batch across it (correct but axis_size× redundant "
+          "compute). Fine for small-batch serving; a training batch "
+          "should be a multiple of the data axis.",
+          RuntimeWarning, stacklevel=2)
   spec = P(batch_axis, axis_name, None, None)
   if block_impl == "flash":
     local = functools.partial(

@@ -23,7 +23,11 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tensor2robot_tpu.parallel.mesh import FSDP_AXIS, MODEL_AXIS
+from tensor2robot_tpu.parallel.mesh import (
+    FSDP_AXIS,
+    MODEL_AXIS,
+    replicated,
+)
 
 
 def fsdp_sharding(
@@ -87,10 +91,23 @@ def tensor_parallel_sharding(
   return jax.tree_util.tree_map(rule, tree)
 
 
+def replicated_sharding(mesh: Mesh, tree: Any,
+                        min_size_to_shard: int = 0) -> Any:
+  """Every leaf fully replicated — pure data parallelism.
+
+  The right choice for models whose state fits comfortably per-chip
+  (most robot-scale networks), and the baseline the collective-audit
+  tests diff fsdp/tp against.
+  """
+  del min_size_to_shard
+  return jax.tree_util.tree_map(lambda _: replicated(mesh), tree)
+
+
 def state_sharding(mesh: Mesh, state: Any,
                    strategy: str = "fsdp",
                    min_size_to_shard: int = 2 ** 10) -> Any:
   """Shardings for a full TrainState (params + opt mirrors, scalars repl)."""
   rule_fn = {"fsdp": fsdp_sharding,
-             "tp": tensor_parallel_sharding}[strategy]
+             "tp": tensor_parallel_sharding,
+             "replicated": replicated_sharding}[strategy]
   return rule_fn(mesh, state, min_size_to_shard=min_size_to_shard)

@@ -1,0 +1,163 @@
+"""Collective-audit: pin the collectives GSPMD inserts per mesh layout.
+
+The sharding rules (`parallel/sharding.py`, `parallel/ring_attention.py`)
+never call collectives directly — XLA's SPMD partitioner inserts them
+from sharding annotations. That indirection is the design (SURVEY.md §3
+parallelism: annotate, let XLA insert, profile), but it means a
+sharding-rule regression fails SILENTLY: params quietly replicate, the
+grad all-reduce disappears, and everything still computes — just slower
+and fatter. These tests compile the real sharded train step for each
+supported layout and assert on the HLO instruction counts, so the
+partitioned program's communication structure is a tested contract:
+
+  * data×fsdp      — gradient all-reduce + zero-style param all-gathers
+  * data×fsdp×model — plus tensor-parallel activation reductions
+  * data×seq ring   — collective-permutes only (no sequence gather!)
+
+Counts are exact for the pinned jax/XLA in the image; if a toolchain
+bump legitimately changes them, update the constants alongside a check
+that the shape of the communication (which ops, roughly how many) still
+matches the layout's story.
+"""
+
+import re
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tensor2robot_tpu import specs
+from tensor2robot_tpu.parallel import (
+    DATA_AXIS,
+    FSDP_AXIS,
+    MODEL_AXIS,
+    SEQ_AXIS,
+    batch_sharding,
+    create_mesh,
+    sequence_sharding,
+    state_sharding,
+)
+from tensor2robot_tpu.parallel.ring_attention import ring_attention
+from tensor2robot_tpu.research.qtopt import GraspingQModel, QTOptLearner
+
+COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",
+               "collective-permute", "all-to-all")
+
+
+def collective_counts(hlo_text: str):
+  """Counts collective INSTRUCTIONS (not metadata mentions) in HLO."""
+  return {
+      op: len(re.findall(rf"= \S+ {op}(?:-start)?\(", hlo_text))
+      for op in COLLECTIVES
+  }
+
+
+def compile_qtopt_step(axes, strategy):
+  """The exact sharded-train-step construction train_eval/dryrun use."""
+  n = int(np.prod(list(axes.values())))
+  mesh = create_mesh(axes, devices=jax.devices()[:n])
+  model = GraspingQModel(
+      image_size=16, torso_filters=(8,), head_filters=(8,),
+      dense_sizes=(16,), action_dim=2, device_dtype=jnp.float32)
+  learner = QTOptLearner(model, cem_population=8, cem_iterations=1,
+                         cem_elites=2)
+  state = learner.create_state(jax.random.PRNGKey(0), batch_size=2)
+  sharding = state_sharding(mesh, state, strategy=strategy,
+                            min_size_to_shard=2 ** 8)
+  transitions = specs.make_random_tensors(
+      learner.transition_specification(), batch_size=16, seed=0)
+  transitions = jax.tree_util.tree_map(jnp.asarray, transitions)
+  ds = batch_sharding(mesh)
+  step = jax.jit(
+      learner.train_step,
+      in_shardings=(sharding, ds, NamedSharding(mesh, P())),
+      out_shardings=(sharding, NamedSharding(mesh, P())))
+  lowered = step.lower(
+      jax.device_put(state, sharding), jax.device_put(transitions, ds),
+      jax.random.PRNGKey(1))
+  return collective_counts(lowered.compile().as_text())
+
+
+class TestTrainStepCollectives:
+
+  def test_fsdp_mesh_gradient_reduce_and_param_gathers(self):
+    counts = compile_qtopt_step({DATA_AXIS: 4, FSDP_AXIS: 2}, "fsdp")
+    # One fused gradient all-reduce over data×fsdp. Zero would mean
+    # each device row trains on its own shard and silently diverges.
+    assert counts["all-reduce"] == 1, counts
+    # Zero-style param/optimizer sharding: every fsdp-sharded tensor
+    # all-gathers for use (forward + recompute). Zero would mean the
+    # state silently replicated — the regression this file exists for.
+    assert counts["all-gather"] == 9, counts
+    # This layout needs no permutes / transposes of the batch.
+    assert counts["collective-permute"] == 0, counts
+    assert counts["all-to-all"] == 0, counts
+
+  def test_tp_mesh_adds_tensor_parallel_reductions(self):
+    counts = compile_qtopt_step(
+        {DATA_AXIS: 2, FSDP_AXIS: 2, MODEL_AXIS: 2}, "tp")
+    # Megatron-style partial-sum reductions of activations (forward
+    # AND backward) on top of the gradient reduce: strictly more
+    # all-reduces than the pure-fsdp layout's single fused one.
+    assert counts["all-reduce"] == 6, counts
+    assert counts["all-gather"] == 43, counts
+    assert counts["all-to-all"] == 0, counts
+
+  def test_fsdp_vs_replicated_baseline(self):
+    """Same step with NO state sharding: the param gathers disappear.
+
+    Proves the all-gathers above are attributable to the fsdp rules.
+    Instructive wrinkle this pins: with every output replicated and
+    the model this tiny, the cost-based partitioner decides sharded
+    compute isn't worth it — it all-gathers the BATCH inputs (3
+    feature tensors) and runs the step replicated, so there is no
+    gradient all-reduce at all. Exactly the silent de-parallelization
+    mode this audit exists to surface: replicated-state DP leaves the
+    sharding decision to a cost model, while the fsdp/tp rules above
+    FORCE distributed state and thereby sharded compute.
+    """
+    counts = compile_qtopt_step({DATA_AXIS: 4, FSDP_AXIS: 2},
+                                "replicated")
+    assert counts["all-reduce"] == 0, counts
+    assert counts["all-gather"] == 3, counts
+
+
+class TestRingCollectives:
+
+  @pytest.fixture()
+  def qkv_sharded(self):
+    mesh = create_mesh({DATA_AXIS: 2, SEQ_AXIS: 4})
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(rng.standard_normal((2, 32, 2, 8)),
+                           jnp.float32) for _ in range(3))
+    sh = sequence_sharding(mesh)
+    return mesh, [jax.device_put(x, sh) for x in (q, k, v)]
+
+  def test_forward_is_permutes_only(self, qkv_sharded):
+    mesh, args = qkv_sharded
+    fwd = jax.jit(lambda q, k, v: ring_attention(
+        q, k, v, mesh=mesh, causal=True))
+    counts = collective_counts(fwd.lower(*args).compile().as_text())
+    # K and V each rotate via ONE permute inside the scanned ring
+    # body. Crucially zero all-gathers: the whole point is that no
+    # device ever materializes the full sequence.
+    assert counts["collective-permute"] == 2, counts
+    assert counts["all-gather"] == 0, counts
+    assert counts["all-reduce"] == 0, counts
+
+  def test_backward_permutes_cotangents_around_the_ring(
+      self, qkv_sharded):
+    mesh, args = qkv_sharded
+    grad = jax.jit(jax.grad(
+        lambda q, k, v: jnp.sum(ring_attention(
+            q, k, v, mesh=mesh, causal=True, block_impl="flash",
+            flash_interpret=True) ** 2), argnums=(0, 1, 2)))
+    counts = collective_counts(grad.lower(*args).compile().as_text())
+    # Flash-block ring is statically unrolled: (ring-1)=3 steps × K,V
+    # = 6 forward permutes, mirrored by 6 transposed permutes carrying
+    # dk/dv cotangents backward around the ring.
+    assert counts["collective-permute"] == 12, counts
+    assert counts["all-gather"] == 0, counts

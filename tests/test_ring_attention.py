@@ -63,6 +63,22 @@ class TestRingAttention:
     np.testing.assert_array_equal(np.asarray(got),
                                   np.asarray(expected))
 
+  def test_non_divisible_batch_warns_and_replicates(self):
+    """Small-batch serving on a data-sharded mesh still works — the
+    batch replicates (with a warning) instead of failing in
+    shard_map; training layouts never hit this (local_batch_size
+    enforces divisibility)."""
+    rng = np.random.default_rng(9)
+    q, k, v = (jnp.asarray(
+        rng.standard_normal((3, 32, 2, 8)).astype(np.float32))
+        for _ in range(3))
+    mesh = create_mesh({DATA_AXIS: 2, SEQ_AXIS: 4})
+    expected = attention_reference(q, k, v, causal=True)
+    with pytest.warns(RuntimeWarning, match="does not divide"):
+      got = ring_attention(q, k, v, mesh=mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               atol=2e-5, rtol=2e-5)
+
   def test_indivisible_sequence_raises(self):
     mesh = create_mesh({SEQ_AXIS: 8})
     q = jnp.zeros((1, 12, 1, 8))
