@@ -1,0 +1,91 @@
+"""Native host-data-path kernels: exactness vs numpy + fallback.
+
+The C++ gather/scatter must be BIT-identical to the numpy fancy-index
+path it accelerates — the replay buffer swaps between them based on
+toolchain availability, so any divergence would make training data
+depend on whether g++ exists.
+"""
+
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.utils import native
+
+
+class TestNativeKernels:
+
+  def test_library_builds_in_image(self):
+    """The image ships g++; the library must actually build here so
+    the native path (not just the fallback) is what CI exercises."""
+    assert native.native_available()
+
+  @pytest.mark.parametrize("dtype", [np.uint8, np.float32, np.int64])
+  def test_gather_matches_numpy(self, dtype):
+    rng = np.random.default_rng(0)
+    src = (rng.integers(0, 255, (1000, 7, 3)).astype(dtype)
+           if np.issubdtype(dtype, np.integer)
+           else rng.standard_normal((1000, 7, 3)).astype(dtype))
+    idx = rng.integers(0, 1000, size=333)
+    np.testing.assert_array_equal(native.gather_rows(src, idx),
+                                  src[idx])
+
+  def test_gather_large_multithread_path(self):
+    """Rows big enough to cross the threading threshold (>1 MB)."""
+    rng = np.random.default_rng(1)
+    src = rng.integers(0, 255, (512, 64, 64, 3)).astype(np.uint8)
+    idx = rng.integers(0, 512, size=256)
+    np.testing.assert_array_equal(
+        native.gather_rows(src, idx, num_threads=4), src[idx])
+
+  def test_gather_into_preallocated_out(self):
+    rng = np.random.default_rng(2)
+    src = rng.standard_normal((100, 5)).astype(np.float32)
+    idx = rng.integers(0, 100, size=40)
+    out = np.empty((40, 5), np.float32)
+    result = native.gather_rows(src, idx, out=out)
+    assert result is out
+    np.testing.assert_array_equal(out, src[idx])
+
+  def test_scatter_matches_numpy(self):
+    rng = np.random.default_rng(3)
+    dst = np.zeros((200, 6, 2), np.float32)
+    expected = dst.copy()
+    idx = rng.permutation(200)[:50]  # distinct, like ring-buffer slots
+    src = rng.standard_normal((50, 6, 2)).astype(np.float32)
+    native.scatter_rows(dst, idx, src)
+    expected[idx] = src
+    np.testing.assert_array_equal(dst, expected)
+
+  def test_gather_negative_indices_match_numpy(self):
+    rng = np.random.default_rng(5)
+    src = rng.standard_normal((30, 4)).astype(np.float32)
+    idx = np.array([-1, 0, -30, 5])
+    np.testing.assert_array_equal(native.gather_rows(src, idx),
+                                  src[idx])
+
+  def test_gather_out_of_bounds_raises(self):
+    """Same IndexError with or without the toolchain — training data
+    must never depend on whether g++ was present."""
+    src = np.zeros((10, 2), np.float32)
+    with pytest.raises(IndexError, match="out of bounds"):
+      native.gather_rows(src, np.array([3, 10]))
+    with pytest.raises(IndexError, match="out of bounds"):
+      native.gather_rows(src, np.array([-11]))
+
+  def test_scatter_shape_mismatch_raises(self):
+    dst = np.zeros((10, 3), np.float32)
+    with pytest.raises(ValueError, match="does not match"):
+      native.scatter_rows(dst, np.array([0, 1]),
+                          np.zeros((2, 4), np.float32))
+    with pytest.raises(ValueError, match="does not match"):
+      native.scatter_rows(dst, np.array([0, 1]),
+                          np.zeros((3, 3), np.float32))
+
+  def test_noncontiguous_falls_back(self):
+    """A transposed (non-C-contiguous) source silently uses numpy."""
+    rng = np.random.default_rng(4)
+    src = rng.standard_normal((6, 50)).astype(np.float32).T
+    assert not src.flags.c_contiguous
+    idx = rng.integers(0, 50, size=20)
+    np.testing.assert_array_equal(native.gather_rows(src, idx),
+                                  src[idx])
