@@ -57,27 +57,39 @@ import numpy as np
 
 PER_CHIP_TARGET = 10_000 / 64.0
 SCAN_STEPS = 200
-TRIALS = 4
+TRIALS = 6
 
 
-def build(paper: bool):
-  """(model, learner, batch_size, config description)."""
+def build(paper, width: int = 64):
+  """(model, learner, batch_size, config description).
+
+  `width` (paper config only): conv/dense channel count. 64 matches
+  the paper's reported widths; 128 is the MXU-sized variant — the
+  bf16 systolic array contracts 128 lanes, so 64-channel convs leave
+  half the array idle (measured: 128-wide runs 2.7× the FLOPs at the
+  same step rate).
+  """
   from tensor2robot_tpu.research.qtopt import (
       GraspingQModel,
       QTOptLearner,
   )
   if paper:
     # QT-Opt-paper scale (arXiv:1806.10293): 472x472 monocular RGB,
-    # ~deep conv stack. Six stride-2 torso convs (472 -> 8 spatial) +
-    # two head convs approximate the paper's depth with this repo's
-    # 3x3/s2 vocabulary.
+    # ~deep conv stack. TPU stem: space_to_depth=4 packs 4x4 pixel
+    # blocks into 48 channels so the first conv contracts 432 taps
+    # instead of 27 (a 3-channel 472x472 stem conv leaves the MXU
+    # reduce dimension ~90% padding); one stride-1 conv at 118x118
+    # then four stride-2 convs reach the same 8x8 map the paper's
+    # stack ends at. FLOPs are re-counted from the compiled program.
     model = GraspingQModel(
         image_size=472,
-        torso_filters=(64, 64, 64, 64, 64, 64),
-        head_filters=(64, 64),
-        dense_sizes=(64, 64))
+        space_to_depth=4,
+        torso_filters=(width,) * 5,
+        head_filters=(width, width),
+        dense_sizes=(width, width))
     batch_size = 64
-    desc = "batch=64, 472x472 uint8, paper-depth, CEM 2x64, bf16"
+    desc = (f"batch=64, 472x472 uint8, s2d-4 stem + paper-depth, "
+            f"width={width}, CEM 2x64, bf16")
   else:
     model = GraspingQModel()  # 64x64 uint8, 4-dim actions, bf16
     batch_size = 256
@@ -87,12 +99,12 @@ def build(paper: bool):
   return model, learner, batch_size, desc
 
 
-def bench_config(paper: bool, profile_dir=None):
+def bench_config(paper: bool, profile_dir=None, width: int = 64):
   """Times the fused Bellman step; returns a detail dict."""
   from tensor2robot_tpu.specs import make_random_tensors
   from tensor2robot_tpu.utils import profiling
 
-  _, learner, batch_size, desc = build(paper)
+  _, learner, batch_size, desc = build(paper, width=width)
   state = learner.create_state(jax.random.PRNGKey(0))
   transitions = make_random_tensors(
       learner.transition_specification(), batch_size=batch_size, seed=0)
@@ -144,11 +156,20 @@ def bench_config(paper: bool, profile_dir=None):
   float(m["loss"])
   per_dispatch = n / (time.perf_counter() - t0)
 
+  top_ops = None
   if profile_dir:
     with profiling.trace(profile_dir):
       with profiling.step_annotation(0):
         state, loss = step(state, transitions, jax.random.PRNGKey(99))
         float(loss)
+    from tensor2robot_tpu.utils import xplane
+    # Durations are summed across the SCAN_STEPS loop iterations of
+    # one dispatch; divide by SCAN_STEPS for per-step ms.
+    top_ops = [
+        {"op": name[:120], "ms_per_dispatch": round(ms, 2)}
+        for name, ms in xplane.top_ops(profile_dir, k=10,
+                                       hlo_only=True)
+    ]
 
   util = profiling.mfu(best, flops_per_step)
   peak = profiling.device_peak_flops()
@@ -160,6 +181,7 @@ def bench_config(paper: bool, profile_dir=None):
   return {
       "config": desc,
       "steps_per_sec_best": round(best, 2),
+      "steps_per_sec_median": round(float(np.median(trials)), 2),
       "steps_per_sec_trials": [round(x, 2) for x in trials],
       "steps_per_sec_per_dispatch": round(per_dispatch, 2),
       "scan_steps_per_dispatch": SCAN_STEPS,
@@ -168,6 +190,7 @@ def bench_config(paper: bool, profile_dir=None):
       "mfu": round(util, 4) if util is not None else None,
       "device_kind": jax.devices()[0].device_kind,
       "peak_bf16_flops": peak,
+      **({"top_ops": top_ops} if top_ops else {}),
   }
 
 
@@ -361,7 +384,10 @@ def main():
     pass
   detail["primary"] = bench_config(False, profile_dir=profile_dir)
   if run_paper:
-    detail["paper_scale"] = bench_config(True)
+    detail["paper_scale"] = bench_config(
+        True, profile_dir=(profile_dir + "_paper") if profile_dir
+        else None)
+    detail["paper_scale_mxu_width"] = bench_config(True, width=128)
   steps = detail["primary"]["steps_per_sec_best"]
   if "--input" in args:
     detail["input_pipeline"] = bench_input_pipeline()

@@ -44,10 +44,12 @@ class GraspingQModel(CriticModel):
                extra_state_features=None,
                use_batch_norm: bool = True,
                sigmoid_q: bool = True,
+               space_to_depth: int = 1,
                device_dtype=jnp.bfloat16,
                **kwargs):
     super().__init__(sigmoid_q=sigmoid_q, target_q_key="target_q",
                      device_dtype=device_dtype, **kwargs)
+    self._space_to_depth = space_to_depth
     self._image_size = image_size
     self._action_dim = action_dim
     self._torso_filters = tuple(torso_filters)
@@ -90,5 +92,6 @@ class GraspingQModel(CriticModel):
         head_filters=self._head_filters,
         dense_sizes=self._dense_sizes,
         use_batch_norm=self._use_batch_norm,
+        space_to_depth=self._space_to_depth,
         dtype=self.device_dtype,
     )

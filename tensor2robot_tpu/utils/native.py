@@ -110,6 +110,13 @@ def gather_rows(src: np.ndarray, idx: np.ndarray,
       idx = np.where(idx < 0, idx + n, idx)
   if out is None:
     out = np.empty((idx.shape[0],) + src.shape[1:], dtype=src.dtype)
+  elif (out.shape != (idx.shape[0],) + src.shape[1:]
+        or out.dtype != src.dtype):
+    # Validate BEFORE the native memcpy: a too-small or reinterpreted
+    # buffer must raise on every path, not corrupt memory on one.
+    raise ValueError(
+        f"gather_rows: out shape/dtype {out.shape}/{out.dtype} does "
+        f"not match {(idx.shape[0],) + src.shape[1:]}/{src.dtype}.")
   lib = load_library()
   if lib is None or not _rows_ok(src) or not _rows_ok(out):
     np.take(src, idx, axis=0, out=out)

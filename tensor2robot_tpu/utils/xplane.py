@@ -111,7 +111,18 @@ def op_times_ms(trace_dir: str,
 
 
 def top_ops(trace_dir: str, k: int = 20,
-            plane_filter: str = "TPU") -> List[Tuple[str, float]]:
-  """Top-k (op name, device ms) pairs, descending."""
+            plane_filter: str = "TPU",
+            hlo_only: bool = False) -> List[Tuple[str, float]]:
+  """Top-k (op name, device ms) pairs, descending.
+
+  `hlo_only` keeps only HLO instruction events (names starting with
+  '%'), dropping the umbrella step/module/while events that each span
+  the whole dispatch and would otherwise dominate the table. Async
+  copy-start events remain: their durations are wall spans that
+  OVERLAP compute, so read them as prefetch windows, not busy time.
+  """
   totals = op_times_ms(trace_dir, plane_filter)
-  return sorted(totals.items(), key=lambda kv: -kv[1])[:k]
+  items = totals.items()
+  if hlo_only:
+    items = [(n, v) for n, v in items if n.startswith("%")]
+  return sorted(items, key=lambda kv: -kv[1])[:k]

@@ -1,0 +1,103 @@
+"""Worker binary for the multi-process jax.distributed test.
+
+Launched (2×) by tests/test_distributed.py with the framework's env
+launch contract (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
+JAX_PROCESS_ID). Each process owns 2 virtual CPU devices; after
+`maybe_initialize_distributed()` the mesh spans all 4 and the SAME
+GSPMD programs a single process would build run across both — a psum
+and one sharded QT-Opt train step, each process feeding only its local
+batch shard (the multi-host infeed contract of
+`data/prefetch.device_put_batch`).
+
+Prints `DISTRIBUTED_OK <process_id> <loss>` on success; the parent
+asserts the marker and that both processes agree on the loss.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2")
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from tensor2robot_tpu.parallel.distributed import (  # noqa: E402
+    maybe_initialize_distributed,
+)
+
+# Env-triggered: this is the launch contract production binaries use
+# (bin/run_t2r_trainer.py calls this before any device use).
+assert maybe_initialize_distributed(), "env trigger did not fire"
+
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from tensor2robot_tpu import specs  # noqa: E402
+from tensor2robot_tpu.data.prefetch import (  # noqa: E402
+    device_put_batch,
+    make_data_sharding,
+)
+from tensor2robot_tpu.parallel import create_mesh  # noqa: E402
+from tensor2robot_tpu.research.qtopt import (  # noqa: E402
+    GraspingQModel,
+    QTOptLearner,
+)
+
+
+def main():
+  assert jax.process_count() == 2, jax.process_count()
+  assert jax.device_count() == 2 * jax.local_device_count(), (
+      jax.device_count(), jax.local_device_count())
+
+  mesh = create_mesh({"data": jax.device_count()})
+
+  # 1. A psum across ALL devices of BOTH processes.
+  total = jax.jit(
+      jax.shard_map(
+          lambda x: jax.lax.psum(x, "data"),
+          mesh=mesh, in_specs=P("data"), out_specs=P()),
+      out_shardings=NamedSharding(mesh, P()))(
+          np.arange(1.0, jax.device_count() + 1.0, dtype=np.float32))
+  expected = float(sum(range(1, jax.device_count() + 1)))
+  got = float(np.asarray(jax.device_get(total))[0])
+  assert got == expected, (got, expected)
+
+  # 2. One sharded QT-Opt train step over the global mesh, each
+  # process contributing only its local batch shard.
+  model = GraspingQModel(
+      image_size=16, torso_filters=(8,), head_filters=(8,),
+      dense_sizes=(16,), action_dim=2, device_dtype=jnp.float32)
+  learner = QTOptLearner(model, cem_population=8, cem_iterations=1,
+                         cem_elites=2)
+  state = learner.create_state(jax.random.PRNGKey(0), batch_size=2)
+  sharding = make_data_sharding(mesh)
+  global_batch = 8
+  local = specs.make_random_tensors(
+      learner.transition_specification(),
+      batch_size=global_batch // jax.process_count(),
+      # Same seed per process is fine: the assertion is on mechanics
+      # (sharded execution), not data distribution.
+      seed=1 + jax.process_index())
+  batch = device_put_batch(
+      jax.tree_util.tree_map(np.asarray, local), sharding)
+
+  step = jax.jit(
+      learner.train_step,
+      in_shardings=(None, sharding, None),
+      out_shardings=(None, NamedSharding(mesh, P())))
+  new_state, metrics = step(state, batch, jax.random.PRNGKey(3))
+  loss = float(np.asarray(jax.device_get(metrics["loss"])))
+  assert np.isfinite(loss), loss
+  step_val = int(np.asarray(jax.device_get(new_state.train_state.step)))
+  assert step_val == 1, step_val
+
+  print(f"DISTRIBUTED_OK {jax.process_index()} {loss:.6f}", flush=True)
+  jax.distributed.shutdown()
+
+
+if __name__ == "__main__":
+  sys.exit(main())

@@ -46,10 +46,17 @@ class TestFlashAttention:
     np.testing.assert_allclose(outs[0], outs[1], atol=2e-6)
     np.testing.assert_allclose(outs[0], outs[2], atol=2e-6)
 
-  def test_indivisible_length_raises(self):
-    q = jnp.zeros((1, 100, 1, 16))
-    with pytest.raises(ValueError, match="divide"):
-      flash_attention(q, q, q, block_q=64, block_k=64, interpret=True)
+  def test_odd_length_auto_blocks(self):
+    """T not divisible by the requested blocks shrinks them instead of
+    failing — exactness is independent of the tiling."""
+    rng = np.random.default_rng(4)
+    q, k, v = (jnp.asarray(rng.standard_normal((1, 96, 2, 16)),
+                           jnp.float32) for _ in range(3))
+    out = flash_attention(q, k, v, causal=True, block_q=64,
+                          block_k=64, interpret=True)
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-6, rtol=2e-6)
 
   @pytest.mark.parametrize("causal", [False, True])
   def test_gradients_match_reference(self, causal):
