@@ -1,0 +1,203 @@
+"""Full-scale success-protocol runs → committed artifacts.
+
+BASELINE.md protocol step 3: score each policy checkpoint by
+closed-loop success on ≥500 held-out episodes, per checkpoint, via the
+per-checkpoint hooks — not a hand-rolled eval. This script trains the
+flagship QT-Opt config and the gripper BC configs to their test-proven
+levels and runs the SAME hooks the trainer runs, at protocol scale
+(512 / 500 episodes), writing `metrics_success_eval.jsonl` next to the
+train metrics and copying the results into
+`artifacts/success_protocol/` (committed so a reader can see
+protocol-scale numbers without running anything).
+
+Usage:
+  python scripts/run_success_protocol.py qtopt    # on the TPU chip
+  python scripts/run_success_protocol.py gripper  # CPU (serving loop
+                                                  # is host-latency
+                                                  # bound through the
+                                                  # device tunnel)
+
+Each mode prints one JSON line per artifact it wrote.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+ARTIFACTS = os.path.join(REPO, "artifacts", "success_protocol")
+
+
+def _emit(name: str, payload: dict) -> None:
+  os.makedirs(ARTIFACTS, exist_ok=True)
+  print(json.dumps({"artifact": name, **payload}))
+
+
+def _copy_jsonl(model_dir: str, tag: str, out_name: str) -> dict:
+  src = os.path.join(model_dir, f"metrics_{tag}.jsonl")
+  dst = os.path.join(ARTIFACTS, out_name)
+  os.makedirs(ARTIFACTS, exist_ok=True)
+  shutil.copyfile(src, dst)
+  records = [json.loads(line) for line in open(src)]
+  return {"records": len(records), "last": records[-1]}
+
+
+def run_qtopt(tmp: str) -> None:
+  """Flagship 64×64 QT-Opt: replay → fused Bellman → 512-episode CEM
+  success eval per checkpoint (QTOptSuccessEvalHook)."""
+  import jax.numpy as jnp  # noqa: F401  (device init)
+
+  from tensor2robot_tpu.hooks import QTOptSuccessEvalHook
+  from tensor2robot_tpu.models import optimizers as opt_lib
+  from tensor2robot_tpu.research.qtopt import (
+      GraspingQModel,
+      QTOptLearner,
+      ReplayBuffer,
+      ToyGraspEnv,
+      train_qtopt,
+  )
+
+  model = GraspingQModel(
+      create_optimizer_fn=lambda: opt_lib.create_optimizer(
+          learning_rate=1e-3))
+  learner = QTOptLearner(model, cem_population=64, cem_iterations=2,
+                         cem_elites=6)
+  env = ToyGraspEnv(image_size=model.image_size,
+                    action_dim=model.action_dim, seed=0)
+  replay = ReplayBuffer(learner.transition_specification(),
+                        capacity=16384)
+  replay.add(env.sample_transitions(16384))
+
+  model_dir = os.path.join(tmp, "qtopt")
+  hook = QTOptSuccessEvalHook(
+      learner,
+      eval_kwargs={"num_episodes": 512,
+                   "image_size": model.image_size, "seed": 5,
+                   "cem_population": 64, "cem_iterations": 3})
+  train_qtopt(
+      learner=learner,
+      model_dir=model_dir,
+      replay_buffer=replay,
+      max_train_steps=2000,
+      batch_size=256,
+      save_checkpoints_steps=500,
+      log_every_steps=250,
+      hooks=[hook],
+  )
+  info = _copy_jsonl(model_dir, "success_eval",
+                     "qtopt_flagship_success_eval.jsonl")
+  _emit("qtopt_flagship_success_eval.jsonl", info)
+
+
+def run_gripper(tmp: str) -> None:
+  """Gripper BC twice over: per-step clone through SuccessEvalHook
+  (500 episodes/checkpoint) and the long-context transformer clone
+  through its history-accumulating EpisodeContextPolicy (500
+  episodes)."""
+  import jax
+
+  from tensor2robot_tpu import train_eval
+  from tensor2robot_tpu.data.tfrecord_input_generator import (
+      TFRecordEpisodeInputGenerator,
+  )
+  from tensor2robot_tpu.hooks import SuccessEvalHook
+  from tensor2robot_tpu.models import optimizers as opt_lib
+  from tensor2robot_tpu.research.vrgripper import (
+      TransitionInputGenerator,
+      VRGripperRegressionModel,
+      VRGripperTransformerModel,
+      collect_demo_episodes,
+      evaluate_gripper_policy,
+  )
+  from tensor2robot_tpu.train_eval import MetricLogger
+  from tensor2robot_tpu.utils import checkpoints as ckpt_lib
+
+  img = 24
+  demos = os.path.join(tmp, "demos.tfrecord")
+  collect_demo_episodes(demos, num_episodes=96, image_size=img,
+                        seed=0, action_noise=0.1)
+
+  # --- Per-step BC clone, protocol through the checkpoint hook. ---
+  bc = VRGripperRegressionModel(
+      image_size=img, filters=(8, 16), embedding_size=32,
+      hidden_sizes=(32,),
+      create_optimizer_fn=lambda: opt_lib.create_optimizer(
+          learning_rate=3e-3))
+  bc_dir = os.path.join(tmp, "bc")
+  train_eval.train_eval_model(
+      model=bc,
+      model_dir=bc_dir,
+      input_generator_train=TransitionInputGenerator(
+          TFRecordEpisodeInputGenerator(
+              file_patterns=demos, sequence_length=12, seed=1),
+          batch_size=32, seed=1),
+      max_train_steps=500,
+      batch_size=32,
+      save_checkpoints_steps=500,
+      log_every_steps=200,
+      hooks=[SuccessEvalHook(
+          eval_fn=evaluate_gripper_policy,
+          eval_kwargs={"num_episodes": 500, "image_size": img,
+                       "seed": 5})],
+  )
+  info = _copy_jsonl(bc_dir, "success_eval",
+                     "vrgripper_bc_success_eval.jsonl")
+  _emit("vrgripper_bc_success_eval.jsonl", info)
+
+  # --- Long-context transformer clone, full-history policy. ---
+  tr = VRGripperTransformerModel(
+      image_size=img, filters=(8, 16), embedding_size=32, width=48,
+      depth=1, num_heads=2, max_context_length=64,
+      attention_impl="reference",
+      create_optimizer_fn=lambda: opt_lib.create_optimizer(
+          learning_rate=3e-3))
+  tr_dir = os.path.join(tmp, "transformer")
+  train_eval.train_eval_model(
+      model=tr,
+      model_dir=tr_dir,
+      input_generator_train=TFRecordEpisodeInputGenerator(
+          file_patterns=demos, sequence_length=16, batch_size=16,
+          shuffle_buffer_size=96, seed=1),
+      max_train_steps=400,
+      batch_size=8,
+      save_checkpoints_steps=400,
+      log_every_steps=100,
+  )
+  state = tr.create_inference_state(jax.random.PRNGKey(0))
+  variables = ckpt_lib.restore_variables(
+      tr_dir, like={"params": state.params,
+                    "batch_stats": state.batch_stats or {}})
+  state = state.replace(params=variables["params"])
+  policy = tr.make_context_policy(state, context_length=16)
+  metrics = evaluate_gripper_policy(
+      policy, num_episodes=500, image_size=img, seed=5)
+  logger = MetricLogger(tr_dir)
+  try:
+    logger.write("success_eval", 400, metrics)
+  finally:
+    logger.close()
+  info = _copy_jsonl(tr_dir, "success_eval",
+                     "vrgripper_transformer_success_eval.jsonl")
+  _emit("vrgripper_transformer_success_eval.jsonl", info)
+
+
+def main():
+  mode = sys.argv[1] if len(sys.argv) > 1 else ""
+  if mode not in ("qtopt", "gripper"):
+    raise SystemExit("usage: run_success_protocol.py {qtopt|gripper}")
+  if mode == "gripper":
+    # Serving loops dispatch per step; host CPU avoids tunnel latency.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+  with tempfile.TemporaryDirectory() as tmp:
+    (run_qtopt if mode == "qtopt" else run_gripper)(tmp)
+
+
+if __name__ == "__main__":
+  main()

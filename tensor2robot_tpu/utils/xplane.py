@@ -115,14 +115,17 @@ def top_ops(trace_dir: str, k: int = 20,
             hlo_only: bool = False) -> List[Tuple[str, float]]:
   """Top-k (op name, device ms) pairs, descending.
 
-  `hlo_only` keeps only HLO instruction events (names starting with
-  '%'), dropping the umbrella step/module/while events that each span
-  the whole dispatch and would otherwise dominate the table. Async
-  copy-start events remain: their durations are wall spans that
-  OVERLAP compute, so read them as prefetch windows, not busy time.
+  `hlo_only` keeps only leaf HLO instruction events: names must start
+  with '%', and '%while'-prefixed spans are dropped too — a while
+  instruction is itself an umbrella covering every loop iteration's
+  ops, so it would top the table with ~the whole dispatch attributed
+  to one "op". Async copy-start events remain: their durations are
+  wall spans that OVERLAP compute, so read them as prefetch windows,
+  not busy time.
   """
   totals = op_times_ms(trace_dir, plane_filter)
   items = totals.items()
   if hlo_only:
-    items = [(n, v) for n, v in items if n.startswith("%")]
+    items = [(n, v) for n, v in items
+             if n.startswith("%") and not n.startswith("%while")]
   return sorted(items, key=lambda kv: -kv[1])[:k]

@@ -13,6 +13,7 @@ import os
 import socket
 import subprocess
 import sys
+from concurrent.futures import ThreadPoolExecutor
 
 import pytest
 
@@ -38,18 +39,26 @@ def test_two_process_cluster_runs_sharded_train_step():
   env["TF_CPP_MIN_LOG_LEVEL"] = "2"
 
   procs = []
-  for i in range(2):
-    worker_env = dict(env)
-    worker_env["JAX_PROCESS_ID"] = str(i)
-    procs.append(subprocess.Popen(
-        [sys.executable, worker],
-        env=worker_env, stdout=subprocess.PIPE,
-        stderr=subprocess.STDOUT, text=True))
+  try:
+    for i in range(2):
+      worker_env = dict(env)
+      worker_env["JAX_PROCESS_ID"] = str(i)
+      procs.append(subprocess.Popen(
+          [sys.executable, worker],
+          env=worker_env, stdout=subprocess.PIPE,
+          stderr=subprocess.STDOUT, text=True))
 
-  outputs = []
-  for i, proc in enumerate(procs):
-    out, _ = proc.communicate(timeout=520)
-    outputs.append(out)
+    # Drain both pipes CONCURRENTLY: a worker blocking on a full
+    # stdout pipe would stall its SPMD collective and hang its peer.
+    with ThreadPoolExecutor(max_workers=2) as pool:
+      futures = [pool.submit(p.communicate, None, 520) for p in procs]
+      outputs = [f.result(timeout=540)[0] for f in futures]
+  finally:
+    for p in procs:
+      if p.poll() is None:
+        p.kill()
+
+  for i, (proc, out) in enumerate(zip(procs, outputs)):
     assert proc.returncode == 0, (
         f"worker {i} failed (rc={proc.returncode}):\n{out[-3000:]}")
 
