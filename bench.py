@@ -43,6 +43,8 @@ Usage: python bench.py [--paper] [--profile DIR] [--input] [--replay]
   --replay   measure the replay path (ReplayBuffer.sample →
              ShardedPrefetcher → device) — the feed the north-star
              QT-Opt loop actually uses.
+  --longcontext  flash-attention forward + train rates at T=32k
+             causal (the long-context serving/training numbers).
 """
 
 from __future__ import annotations
@@ -313,6 +315,62 @@ def bench_replay_pipeline(steps_per_sec: float, batch_size: int = 256,
   }
 
 
+def bench_long_context(t: int = 32768, heads: int = 4, d: int = 64,
+                       scan: int = 10):
+  """Flash-attention forward and train (fwd+bwd) rates at long T.
+
+  The long-context story in one number each way: exact causal
+  attention at T=32k — past where materialized attention OOMs — for
+  serving (forward) and training (the custom VJP's blockwise XLA
+  backward). FLOPs: 4·B·H·D·T²/2 causal forward; backward ≈ 2.5×.
+  """
+  from tensor2robot_tpu.ops.flash_attention import flash_attention
+
+  rng = np.random.default_rng(0)
+  q, k, v = (jnp.asarray(rng.standard_normal((1, t, heads, d)),
+                         jnp.bfloat16) for _ in range(3))
+
+  def scan_timed(inner):
+    @jax.jit
+    def many(q, k, v):
+      def body(c, i):
+        # Cast back: the f32 carry would silently promote q to f32
+        # and the "bf16" label would be a lie.
+        qq = (q + c * jnp.asarray(1e-6, jnp.float32)
+              ).astype(jnp.bfloat16)
+        return inner(qq, k, v) * 1e-9, ()
+      c, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32),
+                          jnp.arange(scan))
+      return c
+    float(many(q, k, v))  # compile + warm
+    best = np.inf
+    for _ in range(3):
+      t0 = time.perf_counter()
+      float(many(q, k, v))  # D2H barrier
+      best = min(best, time.perf_counter() - t0)
+    return best / scan
+
+  fwd_dt = scan_timed(lambda qq, k, v: jnp.sum(
+      flash_attention(qq, k, v, causal=True).astype(jnp.float32)))
+  bwd_dt = scan_timed(lambda qq, k, v: jnp.sum(jax.grad(
+      lambda a: jnp.sum(flash_attention(a, k, v, causal=True)
+                        .astype(jnp.float32) ** 2))(qq)
+      .astype(jnp.float32)))
+  fwd_flops = 4 * 1 * heads * d * t * t / 2
+  return {
+      "config": f"flash attention, T={t} causal, H={heads}, D={d}, "
+                "bf16, scan-amortized",
+      "forward_ms": round(fwd_dt * 1e3, 1),
+      "forward_tflops": round(fwd_flops / fwd_dt / 1e12, 1),
+      "forward_pct_peak": round(
+          fwd_flops / fwd_dt / 197e12 * 100, 1),
+      "train_step_ms": round(bwd_dt * 1e3, 1),
+      "train_tflops_equiv": round(
+          3.5 * fwd_flops / bwd_dt / 1e12, 1),
+      "tokens_per_sec_train": round(t / bwd_dt, 0),
+  }
+
+
 def bench_input_pipeline(batch_size: int = 256, image_size: int = 64,
                          num_records: int = 2048, batches: int = 40):
   """Host tf.data pipeline rate at the bench config (jpeg decode).
@@ -397,6 +455,8 @@ def main():
         detail["input_pipeline"]["images_per_sec"], steps)
   if "--replay" in args:
     detail["replay_pipeline"] = bench_replay_pipeline(steps)
+  if "--longcontext" in args:
+    detail["long_context"] = bench_long_context()
 
   with open("BENCH_DETAIL.json", "w") as f:
     json.dump(detail, f, indent=2)

@@ -1,5 +1,9 @@
 """QT-Opt research family (reference: tensor2robot research/qtopt/)."""
 
+from tensor2robot_tpu.research.qtopt.actor import (
+    ActorStateRefreshHook,
+    GraspActor,
+)
 from tensor2robot_tpu.research.qtopt.cem import (
     CEMResult,
     cem_maximize,

@@ -1,0 +1,170 @@
+"""Online actor: on-policy data collection feeding the QT-Opt learner.
+
+Reference parity: the reference's QT-Opt ran a fleet of robots/sim
+actors pulling policy checkpoints and pushing grasp episodes into the
+replay service while Bellman updaters trained (SURVEY.md §3 "async
+actor/learner distribution" — the system itself was never
+open-sourced). In-repo TPU-native version: actor THREADS share the
+process with the learner loop — the learner's hot path is device-bound
+(one fused XLA program per step), so host threads are free to run
+envs; the mutex'd `ReplayBuffer` is the meeting point, and the
+policy-state handoff mirrors the reference's checkpoint pull via
+`ActorStateRefreshHook` (actors re-pull the acting params whenever the
+trainer checkpoints).
+
+Exploration: ε-greedy over the CEM policy — each episode acts randomly
+with probability ε, otherwise with the jitted batched CEM argmax.
+Before the first state handoff the actor is purely random, which IS
+the bootstrap phase (replaces `prefill_random`'s spec-random tensors
+with real env transitions).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+
+from tensor2robot_tpu import config as gin
+from tensor2robot_tpu.hooks.hook import Hook
+from tensor2robot_tpu.research.qtopt.grasping_env import ToyGraspEnv
+
+
+@gin.configurable
+class GraspActor:
+  """Collects ToyGraspEnv episodes with the current CEM policy.
+
+  Usable synchronously (`collect_once`) or as a background thread
+  (`start`/`stop`). `update_state` swaps the acting parameters
+  atomically; collection before the first swap is uniform-random.
+  """
+
+  def __init__(self,
+               learner,
+               replay_buffer,
+               env: Optional[ToyGraspEnv] = None,
+               batch_episodes: int = 64,
+               epsilon: float = 0.1,
+               cem_population: Optional[int] = None,
+               cem_iterations: Optional[int] = None,
+               seed: int = 0):
+    import jax
+
+    self._learner = learner
+    self._replay = replay_buffer
+    self._env = env or ToyGraspEnv(
+        image_size=learner.model.image_size,
+        action_dim=learner.model.action_dim, seed=seed)
+    self._batch = batch_episodes
+    self._epsilon = float(epsilon)
+    self._policy = jax.jit(learner.build_policy(
+        cem_population=cem_population,
+        cem_iterations=cem_iterations))
+    self._rng = np.random.default_rng(seed)
+    self._jax_key = jax.random.PRNGKey(seed + 1)
+    self._state = None
+    self._state_lock = threading.Lock()
+    self._stop = threading.Event()
+    self._thread: Optional[threading.Thread] = None
+    self.episodes_collected = 0
+    self.reward_sum = 0.0
+
+  def update_state(self, state) -> None:
+    """Swaps the acting parameters (called from the trainer thread)."""
+    with self._state_lock:
+      self._state = state
+
+  def collect_once(self) -> float:
+    """One batch of episodes → replay; returns the batch mean reward."""
+    import jax
+    from tensor2robot_tpu.specs import TensorSpecStruct
+
+    observations, positions = self._env.reset_batch(self._batch)
+    with self._state_lock:
+      state = self._state
+    n = self._batch
+    random_actions = self._rng.uniform(
+        -1, 1, (n, self._env.action_dim)).astype(np.float32)
+    if state is None:
+      actions = random_actions
+    else:
+      self._jax_key, key = jax.random.split(self._jax_key)
+      actions = np.asarray(jax.device_get(self._policy(
+          state,
+          TensorSpecStruct.from_flat_dict(
+              {"image": observations["image"]}), key)))
+      explore = self._rng.random(n) < self._epsilon
+      actions = np.where(explore[:, None], random_actions,
+                         actions).astype(np.float32)
+    reward = self._env.grade(actions, positions)
+    self._replay.add({
+        "image": observations["image"],
+        "action": actions,
+        "reward": reward[:, None].astype(np.float32),
+        "done": np.ones((n, 1), np.float32),
+        "next_image": observations["image"],
+    })
+    self.episodes_collected += n
+    self.reward_sum += float(reward.sum())
+    return float(reward.mean())
+
+  # ---- background-thread lifecycle ----
+
+  def start(self) -> None:
+    """Starts background collection (idempotent — the caller usually
+    starts the actor BEFORE train_qtopt so the random bootstrap can
+    satisfy min_replay_size, and the refresh hook's begin() is then a
+    no-op)."""
+    if self._thread is not None:
+      return
+    self._stop.clear()
+    self._thread = threading.Thread(target=self._run, daemon=True)
+    self._thread.start()
+
+  def _run(self) -> None:
+    while not self._stop.is_set():
+      self.collect_once()
+
+  def stop(self) -> None:
+    self._stop.set()
+    if self._thread is not None:
+      self._thread.join(timeout=30.0)
+      if self._thread.is_alive():
+        # Keep the handle: dropping it would let start() spawn a
+        # SECOND collector while this one is still running.
+        raise RuntimeError(
+            "actor thread did not stop within 30s; still running")
+      self._thread = None
+
+
+@gin.configurable
+class ActorStateRefreshHook(Hook):
+  """Hands each checkpoint's params to the actors — the in-process
+  equivalent of the reference's actors pulling policy checkpoints."""
+
+  def __init__(self, actors):
+    self._actors = list(actors) if isinstance(actors, (list, tuple)) \
+        else [actors]
+
+  def begin(self, model, model_dir: str) -> None:
+    for actor in self._actors:
+      actor.start()
+
+  def after_checkpoint(self, step: int, state, model_dir: str) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    # The trainer DONATES its state buffers into the next step; actors
+    # hold theirs across many steps, so hand them an un-donated device
+    # copy — and only the acting half (params + BN stats), not the
+    # optimizer moments.
+    acting = state.replace(opt_state=None) if hasattr(state, "replace") \
+        else state
+    acting = jax.tree_util.tree_map(jnp.copy, acting)
+    for actor in self._actors:
+      actor.update_state(acting)
+
+  def end(self, step: int, state, model_dir: str) -> None:
+    for actor in self._actors:
+      actor.stop()

@@ -69,6 +69,10 @@ def train_qtopt(
         batch_size=min(replay_buffer.capacity, 4 * batch_size),
         seed=seed)
     replay_buffer.add(fill)
+  # Hooks begin BEFORE the replay wait: an ActorStateRefreshHook whose
+  # actors bootstrap an empty buffer must start collecting now, or
+  # this wait would deadlock.
+  hook_list.begin(learner.model, model_dir)
   replay_buffer.wait_until_size(min_replay_size or batch_size)
 
   rng = jax.random.PRNGKey(seed)
@@ -91,7 +95,6 @@ def train_qtopt(
       donate_argnums=(0,),
   )
 
-  hook_list.begin(learner.model, model_dir)
   prefetcher = prefetch_lib.ShardedPrefetcher(
       replay_buffer.as_stream(batch_size), data_sharding, buffer_size=2)
   step = int(np.asarray(jax.device_get(state.step)))

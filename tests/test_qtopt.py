@@ -365,6 +365,88 @@ class TestGraspSuccessEval:
     assert records[-1]["step"] == 400
 
 
+class TestOnlineActor:
+  """The async actor/learner loop: on-policy collection → replay →
+  Bellman training, with the policy-state handoff via the checkpoint
+  hook (the in-process shape of the reference's actor fleet)."""
+
+  def _tiny(self):
+    from tensor2robot_tpu.models import optimizers as opt_lib
+
+    model = GraspingQModel(
+        image_size=16, action_dim=2, torso_filters=(16, 32),
+        head_filters=(32,), dense_sizes=(32, 32),
+        create_optimizer_fn=lambda: opt_lib.create_optimizer(
+            learning_rate=1e-3))
+    return QTOptLearner(model, cem_population=16, cem_iterations=2,
+                        cem_elites=4)
+
+  def test_bootstrap_then_on_policy_collection(self):
+    from tensor2robot_tpu.research.qtopt import (
+        GraspActor,
+        ReplayBuffer,
+        ToyGraspEnv,
+    )
+
+    learner = self._tiny()
+    replay = ReplayBuffer(learner.transition_specification(),
+                          capacity=2048)
+    env = ToyGraspEnv(image_size=16, action_dim=2, seed=3)
+    actor = GraspActor(learner, replay, env=env, batch_episodes=32,
+                       epsilon=0.0, seed=3)
+    # No state yet: pure random bootstrap.
+    r_random = actor.collect_once()
+    assert len(replay) == 32
+    assert 0.0 <= r_random <= 1.0
+    # With a state: the CEM policy acts (any state works mechanically).
+    actor.update_state(learner.create_state(RNG))
+    actor.collect_once()
+    assert len(replay) == 64
+    assert actor.episodes_collected == 64
+
+  def test_online_loop_learns_from_its_own_data(self, tmp_path):
+    """Replay starts EMPTY: the actor's random bootstrap fills it, the
+    trainer learns, checkpoints refresh the acting policy, and the
+    final policy must decisively beat random — the full online RL
+    loop turning on self-collected data only."""
+    from tensor2robot_tpu.research.qtopt import (
+        ActorStateRefreshHook,
+        GraspActor,
+        ReplayBuffer,
+        ToyGraspEnv,
+        evaluate_grasp_policy,
+    )
+
+    learner = self._tiny()
+    replay = ReplayBuffer(learner.transition_specification(),
+                          capacity=8192)
+    env = ToyGraspEnv(image_size=16, action_dim=2, seed=11)
+    actor = GraspActor(learner, replay, env=env, batch_episodes=128,
+                       epsilon=0.2, seed=11)
+    actor.start()  # random bootstrap unblocks min_replay_size
+    try:
+      state = train_qtopt(
+          learner=learner,
+          model_dir=str(tmp_path / "online"),
+          replay_buffer=replay,
+          max_train_steps=500,
+          batch_size=64,
+          min_replay_size=512,
+          save_checkpoints_steps=100,
+          log_every_steps=250,
+          hooks=[ActorStateRefreshHook(actor)],
+      )
+    finally:
+      actor.stop()
+
+    assert actor.episodes_collected >= 1024  # kept collecting
+    metrics = evaluate_grasp_policy(
+        learner, state, num_episodes=256, image_size=16, seed=7,
+        cem_population=64, cem_iterations=3)
+    assert metrics["success_rate"] > max(
+        0.5, 2.5 * metrics["random_baseline_success_rate"]), metrics
+
+
 class TestTrainQTOpt:
 
   def test_end_to_end_loop(self, tmp_path):
