@@ -45,6 +45,8 @@ Usage: python bench.py [--paper] [--profile DIR] [--input] [--replay]
              QT-Opt loop actually uses.
   --longcontext  flash-attention forward + train rates at T=32k
              causal (the long-context serving/training numbers).
+  --podscale measure per-chip step rate at pod-local batch sizes
+             (weak vs strong scaling anchors for the 10k target).
 """
 
 from __future__ import annotations
@@ -101,6 +103,40 @@ def build(paper, width: int = 64):
   return model, learner, batch_size, desc
 
 
+def _scan_step_rate(learner, transitions, scan: int, trials: int):
+  """THE timing harness: scan-amortized steps with the D2H barrier.
+
+  Returns (best_steps_per_sec, trial_rates, (step_fn, final_state)).
+  Every Bellman-step rate in this file goes through here so the
+  methodology (scan amortization, donation, float(loss) barrier —
+  module docstring) lives in exactly one place.
+  """
+  state = learner.create_state(jax.random.PRNGKey(0))
+
+  def k_steps(state, transitions, rng):
+    def body(carry, i):
+      st, _ = carry
+      st, metrics = learner.train_step(
+          st, transitions, jax.random.fold_in(rng, i))
+      return (st, metrics["loss"]), ()
+    (state, loss), _ = jax.lax.scan(
+        body, (state, jnp.zeros(())), jnp.arange(scan))
+    return state, loss
+
+  step = jax.jit(k_steps, donate_argnums=(0,))
+  # Warmup (also materializes donated state on device). float() is
+  # the D2H barrier; block_until_ready lies here.
+  state, loss = step(state, transitions, jax.random.PRNGKey(2))
+  float(loss)
+  rates = []
+  for t in range(trials):
+    t0 = time.perf_counter()
+    state, loss = step(state, transitions, jax.random.PRNGKey(3 + t))
+    float(loss)
+    rates.append(scan / (time.perf_counter() - t0))
+  return max(rates), rates, (step, state)
+
+
 def bench_config(paper: bool, profile_dir=None, width: int = 64):
   """Times the fused Bellman step; returns a detail dict."""
   from tensor2robot_tpu.specs import make_random_tensors
@@ -119,30 +155,8 @@ def bench_config(paper: bool, profile_dir=None, width: int = 64):
   flops_per_step = profiling.compiled_flops_per_call(
       single.lower(state, transitions, jax.random.PRNGKey(2)).compile())
 
-  def k_steps(state, transitions, rng):
-    def body(carry, i):
-      st, _ = carry
-      st, metrics = learner.train_step(
-          st, transitions, jax.random.fold_in(rng, i))
-      return (st, metrics["loss"]), ()
-    (state, loss), _ = jax.lax.scan(
-        body, (state, jnp.zeros(())), jnp.arange(SCAN_STEPS))
-    return state, loss
-
-  step = jax.jit(k_steps, donate_argnums=(0,))
-
-  # Warmup (also materializes donated state on device). float() is the
-  # D2H barrier — see module docstring; block_until_ready lies here.
-  state, loss = step(state, transitions, jax.random.PRNGKey(2))
-  float(loss)
-
-  trials = []
-  for t in range(TRIALS):
-    t0 = time.perf_counter()
-    state, loss = step(state, transitions, jax.random.PRNGKey(3 + t))
-    float(loss)
-    trials.append(SCAN_STEPS / (time.perf_counter() - t0))
-  best = max(trials)
+  best, trials, (step, state) = _scan_step_rate(
+      learner, transitions, SCAN_STEPS, TRIALS)
 
   # Per-dispatch comparison (one jitted step per host call): on a
   # tunneled chip this measures dispatch latency, recorded for honesty.
@@ -315,6 +329,50 @@ def bench_replay_pipeline(steps_per_sec: float, batch_size: int = 256,
   }
 
 
+def bench_pod_scaling(scan: int = 200):
+  """Per-chip Bellman-step rate at pod-local batch sizes.
+
+  The 10k-steps/s-on-v5e-64 north star decomposes differently by
+  scaling mode, and this section records the honest single-chip
+  anchors for each:
+
+  * WEAK scaling (batch 256 per chip → global 16384): pod sync rate =
+    the primary bench's per-chip rate; `vs_baseline` (rate / 156.25)
+    is exactly this framing.
+  * STRONG scaling (global batch stays 256 → 4 per chip): pod sync
+    rate = the b=4 per-chip rate measured here, MINUS collective
+    time — every chip steps together, so tiny-batch per-step overhead
+    is the ceiling. Measured ~1k steps/s: literal 10k SYNC steps/s
+    needs ≤100 µs/step, which this model's fixed per-step cost does
+    not admit; hitting the aggregate number takes larger per-chip
+    batches or async/local-update designs.
+  """
+  from tensor2robot_tpu.specs import make_random_tensors
+  from tensor2robot_tpu.research.qtopt import (
+      GraspingQModel,
+      QTOptLearner,
+  )
+
+  rates = {}
+  for bs in (4, 16, 64):
+    model = GraspingQModel()
+    learner = QTOptLearner(model, cem_iterations=2, cem_population=64,
+                           cem_elites=6)
+    tr = make_random_tensors(learner.transition_specification(),
+                             batch_size=bs, seed=0)
+    tr = jax.device_put(jax.tree_util.tree_map(np.asarray, tr))
+    best, _, _ = _scan_step_rate(learner, tr, scan, trials=3)
+    rates[f"local_batch_{bs}"] = round(best, 1)
+  return {
+      "per_chip_steps_per_sec": rates,
+      "note": ("strong-scaling global-256 over 64 chips runs at the "
+               "local_batch_4 rate (pre-collective) — the sync-step "
+               "ceiling; weak scaling (256/chip) runs at the primary "
+               "rate. local_batch_16 is the per-step-overhead sweet "
+               "spot on this model."),
+  }
+
+
 def bench_long_context(t: int = 32768, heads: int = 4, d: int = 64,
                        scan: int = 10):
   """Flash-attention forward and train (fwd+bwd) rates at long T.
@@ -356,14 +414,17 @@ def bench_long_context(t: int = 32768, heads: int = 4, d: int = 64,
       lambda a: jnp.sum(flash_attention(a, k, v, causal=True)
                         .astype(jnp.float32) ** 2))(qq)
       .astype(jnp.float32)))
+  from tensor2robot_tpu.utils import profiling
+
   fwd_flops = 4 * 1 * heads * d * t * t / 2
+  peak = profiling.device_peak_flops() or float("nan")
   return {
       "config": f"flash attention, T={t} causal, H={heads}, D={d}, "
                 "bf16, scan-amortized",
       "forward_ms": round(fwd_dt * 1e3, 1),
       "forward_tflops": round(fwd_flops / fwd_dt / 1e12, 1),
       "forward_pct_peak": round(
-          fwd_flops / fwd_dt / 197e12 * 100, 1),
+          fwd_flops / fwd_dt / peak * 100, 1),
       "train_step_ms": round(bwd_dt * 1e3, 1),
       "train_tflops_equiv": round(
           3.5 * fwd_flops / bwd_dt / 1e12, 1),
@@ -457,6 +518,8 @@ def main():
     detail["replay_pipeline"] = bench_replay_pipeline(steps)
   if "--longcontext" in args:
     detail["long_context"] = bench_long_context()
+  if "--podscale" in args:
+    detail["pod_scaling"] = bench_pod_scaling()
 
   with open("BENCH_DETAIL.json", "w") as f:
     json.dump(detail, f, indent=2)

@@ -131,8 +131,13 @@ def train_qtopt(
                   params=host_state.train_state.params,
                   batch_stats=host_state.train_state.batch_stats)
       hook_list.after_checkpoint(step, state.train_state, model_dir)
-    hook_list.end(step, state.train_state, model_dir)
   finally:
+    # end() in the FINALLY: hooks now own real teardown (actor
+    # threads); a training-loop exception must not leak collectors.
+    try:
+      hook_list.end(step, state.train_state, model_dir)
+    except Exception:  # noqa: BLE001 — don't mask the original error
+      log.exception("hook end() failed during teardown")
     prefetcher.close()
     writer.close()
     metric_logger.close()
