@@ -103,15 +103,19 @@ def build(paper, width: int = 64):
   return model, learner, batch_size, desc
 
 
-def _scan_step_rate(learner, transitions, scan: int, trials: int):
+def _scan_step_rate(learner, transitions, scan: int, trials: int,
+                    state=None):
   """THE timing harness: scan-amortized steps with the D2H barrier.
 
   Returns (best_steps_per_sec, trial_rates, (step_fn, final_state)).
   Every Bellman-step rate in this file goes through here so the
   methodology (scan amortization, donation, float(loss) barrier —
-  module docstring) lives in exactly one place.
+  module docstring) lives in exactly one place. `state` (optional)
+  reuses a caller-created TrainState instead of re-initializing; it
+  is DONATED into the timed loop.
   """
-  state = learner.create_state(jax.random.PRNGKey(0))
+  if state is None:
+    state = learner.create_state(jax.random.PRNGKey(0))
 
   def k_steps(state, transitions, rng):
     def body(carry, i):
@@ -156,7 +160,7 @@ def bench_config(paper: bool, profile_dir=None, width: int = 64):
       single.lower(state, transitions, jax.random.PRNGKey(2)).compile())
 
   best, trials, (step, state) = _scan_step_rate(
-      learner, transitions, SCAN_STEPS, TRIALS)
+      learner, transitions, SCAN_STEPS, TRIALS, state=state)
 
   # Per-dispatch comparison (one jitted step per host call): on a
   # tunneled chip this measures dispatch latency, recorded for honesty.
@@ -348,16 +352,12 @@ def bench_pod_scaling(scan: int = 200):
     batches or async/local-update designs.
   """
   from tensor2robot_tpu.specs import make_random_tensors
-  from tensor2robot_tpu.research.qtopt import (
-      GraspingQModel,
-      QTOptLearner,
-  )
 
   rates = {}
   for bs in (4, 16, 64):
-    model = GraspingQModel()
-    learner = QTOptLearner(model, cem_iterations=2, cem_population=64,
-                           cem_elites=6)
+    # Same model/learner construction as the primary bench — the
+    # anchors must measure the config the primary number measures.
+    _, learner, _, _ = build(False)
     tr = make_random_tensors(learner.transition_specification(),
                              batch_size=bs, seed=0)
     tr = jax.device_put(jax.tree_util.tree_map(np.asarray, tr))
@@ -417,14 +417,15 @@ def bench_long_context(t: int = 32768, heads: int = 4, d: int = 64,
   from tensor2robot_tpu.utils import profiling
 
   fwd_flops = 4 * 1 * heads * d * t * t / 2
-  peak = profiling.device_peak_flops() or float("nan")
+  peak = profiling.device_peak_flops()
   return {
       "config": f"flash attention, T={t} causal, H={heads}, D={d}, "
                 "bf16, scan-amortized",
       "forward_ms": round(fwd_dt * 1e3, 1),
       "forward_tflops": round(fwd_flops / fwd_dt / 1e12, 1),
-      "forward_pct_peak": round(
-          fwd_flops / fwd_dt / peak * 100, 1),
+      # None (valid JSON), not NaN, when the device peak is unknown.
+      "forward_pct_peak": (round(fwd_flops / fwd_dt / peak * 100, 1)
+                           if peak else None),
       "train_step_ms": round(bwd_dt * 1e3, 1),
       "train_tflops_equiv": round(
           3.5 * fwd_flops / bwd_dt / 1e12, 1),

@@ -82,11 +82,21 @@ class SavedModelExportGenerator(AbstractExportGenerator):
       return dict(outputs)
 
     batch_dim = None if self._batch_polymorphic else 1
-    poly = "(b, ...)" if self._batch_polymorphic else None
+    # Sequence specs (is_sequence) carry a time axis between batch and
+    # the per-step shape — episode-consuming models (the long-context
+    # transformer family) serve [B, T, ...] batches, so the time dim
+    # is always polymorphic in the export.
+    seq_keys = {k for k, s in flat_specs.items()
+                if getattr(s, "is_sequence", False)}
+    b_sym = "b" if self._batch_polymorphic else "1"
+    use_poly = self._batch_polymorphic or bool(seq_keys)
+    poly_map = {
+        k: f"({b_sym}, t, ...)" if k in seq_keys else f"({b_sym}, ...)"
+        for k in flat_specs
+    }
     converted = jax2tf.convert(
         predict_flat,
-        polymorphic_shapes=[{k: poly for k in flat_specs}]
-        if self._batch_polymorphic else None,
+        polymorphic_shapes=[poly_map] if use_poly else None,
         # Robots deserve a model that runs wherever they are: lower for
         # CPU and TPU regardless of which backend the trainer ran on.
         native_serialization_platforms=("cpu", "tpu"),
@@ -98,9 +108,10 @@ class SavedModelExportGenerator(AbstractExportGenerator):
     # (a/b/c) are sanitized; predictors apply the same mapping.
     check_signature_keys(flat_specs)
     input_sigs = {
-        key: tf.TensorSpec([batch_dim] + list(spec.shape),
-                           _tf_dtype(tf, spec),
-                           name=sanitize_signature_key(key))
+        key: tf.TensorSpec(
+            [batch_dim] + ([None] if key in seq_keys else [])
+            + list(spec.shape),
+            _tf_dtype(tf, spec), name=sanitize_signature_key(key))
         for key, spec in flat_specs.items()
     }
 

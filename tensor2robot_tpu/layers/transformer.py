@@ -134,7 +134,11 @@ class CausalTransformer(nn.Module):
   @nn.compact
   def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
     b, t, _ = x.shape
-    if t > self.max_len:
+    # isinstance guard: under jax2tf shape polymorphism (the export
+    # path) t is a symbolic dimension and the comparison would be
+    # inconclusive; serving-side length enforcement then falls to the
+    # positional-table slice below (which fails loudly past max_len).
+    if isinstance(t, int) and t > self.max_len:
       raise ValueError(f"sequence length {t} > max_len {self.max_len}")
     if self.width % self.num_heads:
       raise ValueError(
@@ -148,7 +152,15 @@ class CausalTransformer(nn.Module):
     positions = self.param(
         "positions", nn.initializers.normal(0.02),
         (self.max_len, self.width))
-    x = x + positions[None, :t].astype(self.dtype)
+    # iota-gather instead of positions[:t]: basic slicing rejects the
+    # symbolic t of the jax2tf-polymorphic export path, while a
+    # dimension-sized arange is supported. mode="clip": in an exported
+    # graph a t > max_len request repeats the last learned position
+    # (predictable degradation) rather than jnp.take's default
+    # fill-with-NaN; in-process callers still get the loud ValueError
+    # from the isinstance guard above.
+    pos_t = jnp.take(positions, jnp.arange(t), axis=0, mode="clip")
+    x = x + pos_t[None].astype(self.dtype)
     for i in range(self.depth):
       x = TransformerBlock(
           num_heads=self.num_heads, head_dim=head_dim,

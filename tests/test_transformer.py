@@ -237,6 +237,47 @@ class TestTransformerBC:
     # buffer (stale context, missing resets) tanks this immediately.
     assert metrics["success_rate"] >= 0.4, metrics
 
+  def test_savedmodel_export_round_trip(self, run):
+    """The long-context family serves through the SAME jax2tf
+    SavedModel handoff as every other model: exported per-step
+    actions must match checkpoint serving over a full episode batch
+    (sequence specs ride the export signature as [B, T, ...])."""
+    from tensor2robot_tpu.export import SavedModelExportGenerator
+    from tensor2robot_tpu.predictors import (
+        CheckpointPredictor,
+        SavedModelPredictor,
+    )
+    from tensor2robot_tpu.utils import checkpoints as ckpt_lib
+
+    model, model_dir = run
+    state = model.create_inference_state(jax.random.PRNGKey(0))
+    variables = ckpt_lib.restore_variables(
+        model_dir, like={"params": state.params,
+                         "batch_stats": state.batch_stats or {}})
+    state = state.replace(params=variables["params"])
+    export_dir = SavedModelExportGenerator(
+        include_tf_example_signature=False).export(
+            model, jax.device_get(state), model_dir)
+    predictor = SavedModelPredictor(export_dir.rsplit("/", 1)[0])
+    assert predictor.restore(timeout_secs=0)
+
+    rng = np.random.default_rng(17)
+    t = 16
+    batch = {
+        "image": rng.integers(0, 255, (2, t, IMG, IMG, 3)
+                              ).astype(np.uint8),
+        "gripper_pose": rng.standard_normal((2, t, 3)
+                                            ).astype(np.float32),
+    }
+    exported = predictor.predict(batch)
+    checkpoint = CheckpointPredictor(model, checkpoint_dir=model_dir)
+    assert checkpoint.restore(timeout_secs=0)
+    native = checkpoint.predict(batch)
+    assert np.asarray(exported["action"]).shape == (2, t, 3)
+    np.testing.assert_allclose(
+        np.asarray(exported["action"]), np.asarray(native["action"]),
+        atol=2e-2, rtol=2e-2)
+
   def test_masked_loss_ignores_padding(self):
     model = tiny_model()
     state = model.create_train_state(jax.random.PRNGKey(0))
