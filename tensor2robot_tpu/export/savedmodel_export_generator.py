@@ -21,6 +21,7 @@ SavedModel, exactly where reference consumers look for them.
 from __future__ import annotations
 
 import os
+import warnings
 from typing import Any, Dict, Optional
 
 import jax
@@ -50,10 +51,25 @@ class SavedModelExportGenerator(AbstractExportGenerator):
   def __init__(self,
                export_dir_base: Optional[str] = None,
                include_tf_example_signature: bool = True,
-               batch_polymorphic: bool = True):
+               batch_polymorphic: bool = True,
+               sequence_example_length: Optional[int] = None):
+    """Args:
+      export_dir_base: where timestamped exports land.
+      include_tf_example_signature: also emit a serialized-proto
+        signature. For flat specs that is `parse_tf_example`
+        (tf.Example wire); sequence specs cannot ride tf.Example, so
+        episode models emit `parse_tf_sequence_example` instead —
+        which needs `sequence_example_length` — or, when no length is
+        given, skip the proto signature with a warning (the
+        `serving_default` numpy signature always works).
+      batch_polymorphic: symbolic batch dim in the exported graph.
+      sequence_example_length: static time-axis length the
+        tf.SequenceExample parse signature pads/truncates episodes to.
+    """
     super().__init__(export_dir_base)
     self._include_tf_example_signature = include_tf_example_signature
     self._batch_polymorphic = batch_polymorphic
+    self._sequence_example_length = sequence_example_length
 
   def export(self, model: Any, state: Any, model_dir: str) -> str:
     from jax.experimental import jax2tf  # lazy: TF import is slow
@@ -121,7 +137,7 @@ class SavedModelExportGenerator(AbstractExportGenerator):
 
     signatures = {"serving_default": serving_default}
 
-    if self._include_tf_example_signature:
+    if self._include_tf_example_signature and not seq_keys:
 
       @tf.function(input_signature=[
           tf.TensorSpec([batch_dim], tf.string, name="examples")])
@@ -133,6 +149,36 @@ class SavedModelExportGenerator(AbstractExportGenerator):
         return converted(flat)
 
       signatures["parse_tf_example"] = parse_tf_example
+    elif (self._include_tf_example_signature
+          and self._sequence_example_length is not None):
+      seq_len = int(self._sequence_example_length)
+
+      @tf.function(input_signature=[
+          tf.TensorSpec([batch_dim], tf.string, name="examples")])
+      def parse_tf_sequence_example(serialized):
+        # Episodes travel as tf.SequenceExample; same graph parser as
+        # the training-side episode pipeline, padded/truncated to the
+        # declared static length.
+        flat = tfexample.graph_parse_sequence_example(
+            serialized, feature_spec, seq_len)
+        # The parser's true-lengths output is not a model feature.
+        flat.pop(tfexample.SEQUENCE_LENGTH_KEY, None)
+        return converted(flat)
+
+      signatures["parse_tf_sequence_example"] = parse_tf_sequence_example
+    elif self._include_tf_example_signature:
+      # Sequence specs cannot be bound to the tf.Example wire
+      # (data/tfexample.py build_feature_map raises); without a
+      # declared static episode length there is no proto signature to
+      # build. serving_default still serves [B, T, ...] batches.
+      warnings.warn(
+          f"Skipping the serialized-proto serving signature: feature "
+          f"specs {sorted(seq_keys)} are sequences, which travel as "
+          f"tf.SequenceExample, and no sequence_example_length was "
+          f"configured. Pass "
+          f"SavedModelExportGenerator.sequence_example_length to emit "
+          f"parse_tf_sequence_example, or serve via serving_default.",
+          RuntimeWarning, stacklevel=2)
 
     export_base = self.export_dir_base(model_dir)
     export_dir, tmp_dir = claim_timestamped_export_dir(export_base)

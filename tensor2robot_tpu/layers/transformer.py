@@ -88,7 +88,14 @@ class MultiHeadAttention(nn.Module):
 
 
 class TransformerBlock(nn.Module):
-  """Pre-LN block: x + MHA(LN(x)); x + MLP(LN(x))."""
+  """Pre-LN block: x + MHA(LN(x)); x + MLP(LN(x)).
+
+  With `moe_experts > 0` the dense MLP becomes a MoE layer
+  (`parallel/moe.py`): routed capacity scales with expert count, not
+  per-token FLOPs, and with a mesh `expert` axis the experts run
+  expert-parallel. Dropped-token rows pass through on the residual —
+  the Switch-transformer semantics.
+  """
 
   num_heads: int
   head_dim: int
@@ -97,6 +104,9 @@ class TransformerBlock(nn.Module):
   causal: bool = True
   mesh: Optional[Any] = None
   dtype: Any = jnp.bfloat16
+  moe_experts: int = 0
+  moe_k: int = 2
+  moe_capacity_factor: float = 2.0
 
   @nn.compact
   def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
@@ -107,10 +117,18 @@ class TransformerBlock(nn.Module):
         attention_impl=self.attention_impl, causal=self.causal,
         mesh=self.mesh, dtype=self.dtype, name="attn")(y, train=train)
     y = nn.LayerNorm(dtype=self.dtype, name="ln_mlp")(x)
-    y = nn.Dense(width * self.mlp_ratio, dtype=self.dtype,
-                 name="mlp_in")(y)
-    y = nn.gelu(y)
-    y = nn.Dense(width, dtype=self.dtype, name="mlp_out")(y)
+    if self.moe_experts:
+      from tensor2robot_tpu.parallel.moe import MoEMLP
+      y = MoEMLP(
+          num_experts=self.moe_experts,
+          hidden_dim=width * self.mlp_ratio, k=self.moe_k,
+          capacity_factor=self.moe_capacity_factor, mesh=self.mesh,
+          dtype=self.dtype, name="moe")(y)
+    else:
+      y = nn.Dense(width * self.mlp_ratio, dtype=self.dtype,
+                   name="mlp_in")(y)
+      y = nn.gelu(y)
+      y = nn.Dense(width, dtype=self.dtype, name="mlp_out")(y)
     return x + y
 
 
@@ -130,14 +148,23 @@ class CausalTransformer(nn.Module):
   causal: bool = True
   mesh: Optional[Any] = None
   dtype: Any = jnp.bfloat16
+  # MoE: every `moe_every`-th block (1-indexed from the top of each
+  # group) swaps its dense MLP for `moe_experts` routed experts; 0
+  # disables. The GShard convention is every-other-block (moe_every=2).
+  moe_experts: int = 0
+  moe_every: int = 2
+  moe_k: int = 2
+  moe_capacity_factor: float = 2.0
 
   @nn.compact
   def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
     b, t, _ = x.shape
     # isinstance guard: under jax2tf shape polymorphism (the export
     # path) t is a symbolic dimension and the comparison would be
-    # inconclusive; serving-side length enforcement then falls to the
-    # positional-table slice below (which fails loudly past max_len).
+    # inconclusive. There is NO loud serving-side length check — an
+    # exported graph fed t > max_len silently clips to the last
+    # learned position (see the mode="clip" note below); in-process
+    # callers get this ValueError.
     if isinstance(t, int) and t > self.max_len:
       raise ValueError(f"sequence length {t} > max_len {self.max_len}")
     if self.width % self.num_heads:
@@ -162,10 +189,15 @@ class CausalTransformer(nn.Module):
     pos_t = jnp.take(positions, jnp.arange(t), axis=0, mode="clip")
     x = x + pos_t[None].astype(self.dtype)
     for i in range(self.depth):
+      is_moe = (self.moe_experts > 0
+                and (i + 1) % max(self.moe_every, 1) == 0)
       x = TransformerBlock(
           num_heads=self.num_heads, head_dim=head_dim,
           attention_impl=self.attention_impl, causal=self.causal,
           mesh=self.mesh, dtype=self.dtype, name=f"block{i}",
+          moe_experts=self.moe_experts if is_moe else 0,
+          moe_k=self.moe_k,
+          moe_capacity_factor=self.moe_capacity_factor,
       )(x, train=train)
     return nn.LayerNorm(dtype=self.dtype, name="ln_out")(
         x).astype(jnp.float32)

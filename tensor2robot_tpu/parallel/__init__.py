@@ -2,13 +2,27 @@
 
 from tensor2robot_tpu.parallel.mesh import (
     DATA_AXIS,
+    EXPERT_AXIS,
     FSDP_AXIS,
     MODEL_AXIS,
     SEQ_AXIS,
+    STAGE_AXIS,
     batch_sharding,
     create_mesh,
     local_batch_size,
     replicated,
+)
+from tensor2robot_tpu.parallel.pipeline import (
+    init_stage_params,
+    pipeline_apply,
+    stage_sharding,
+)
+from tensor2robot_tpu.parallel.moe import (
+    MoEMLP,
+    collect_aux_losses,
+    expert_capacity,
+    moe_mlp,
+    top_k_routing,
 )
 from tensor2robot_tpu.parallel.distributed import (
     maybe_initialize_distributed,
@@ -19,6 +33,7 @@ from tensor2robot_tpu.parallel.ring_attention import (
     sequence_sharding,
 )
 from tensor2robot_tpu.parallel.sharding import (
+    expert_sharding,
     fsdp_sharding,
     state_sharding,
     tensor_parallel_sharding,

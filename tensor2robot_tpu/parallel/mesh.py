@@ -10,6 +10,10 @@ used across the framework:
                 combined with ``data`` for the batch dimension.
   * ``model`` — tensor-parallel axis for wide layers.
   * ``seq``   — sequence/context-parallel axis (ring attention).
+  * ``expert`` — expert-parallel axis (MoE layers; tokens all-to-all
+                 to the devices holding their routed experts).
+  * ``stage`` — pipeline-parallel axis (layer stages; activations
+                ppermute stage-to-stage over microbatches).
 
 The reference never goes beyond data parallel; the extra axes exist so
 the same step functions scale to pod slices without restructuring.
@@ -27,6 +31,8 @@ DATA_AXIS = "data"
 FSDP_AXIS = "fsdp"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
+EXPERT_AXIS = "expert"
+STAGE_AXIS = "stage"
 
 
 def create_mesh(

@@ -1,0 +1,255 @@
+"""Mixture-of-experts with expert parallelism over the `expert` axis.
+
+The reference has no MoE and no expert parallelism (SURVEY.md §3
+parallelism inventory marks EP "n/a"); the `expert` mesh axis exists so
+the transformer trunk scales capacity without scaling per-token FLOPs —
+the same reason the `seq` axis carries ring attention. The design is
+the standard static-shape GShard/Switch formulation, built TPU-first:
+
+  * Routing is top-k softmax gating with a STATIC per-group capacity
+    C = ceil(k · tokens/E · capacity_factor): dispatch and combine are
+    dense one-hot einsums over [tokens, E, C], so XLA sees fixed
+    shapes — no sorts with dynamic output sizes, no ragged buffers.
+    Tokens past an expert's capacity are dropped (their combine weight
+    is zero and the residual stream carries them through unchanged —
+    the Switch-transformer semantics).
+  * Expert parallelism is a `shard_map` over the `expert` axis: each
+    device routes ITS OWN tokens (router weights replicated, router
+    math is tiny), then one `lax.all_to_all` carries dispatched tokens
+    to the devices holding their experts and a second carries expert
+    outputs back. Both are differentiable (transpose of all-to-all is
+    all-to-all), so training works through the sharded path.
+  * Capacity is per token-group (= per device), so device count only
+    changes WHICH tokens overflow a full expert, never the math of
+    routed tokens: with capacity_factor high enough that nothing
+    drops, the sharded result equals the single-device reference
+    exactly (tested).
+
+`moe_mlp` is the functional core (used under shard_map and as the
+single-device reference); `MoEMLP` is the flax module that owns the
+params and sows the load-balance auxiliary loss.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from tensor2robot_tpu.parallel.mesh import EXPERT_AXIS
+
+_EPS = 1e-9
+
+
+def expert_capacity(num_tokens: int, num_experts: int, k: int,
+                    capacity_factor: float) -> int:
+  """Static per-group expert capacity (≥1 so every expert has a slot)."""
+  return max(1, int(np.ceil(
+      k * num_tokens / num_experts * capacity_factor)))
+
+
+def top_k_routing(
+    logits: jax.Array,
+    capacity: int,
+    k: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+  """Builds dense dispatch/combine tensors from router logits.
+
+  Args:
+    logits: [N, E] router logits for one token group (f32).
+    capacity: static slots per expert for this group.
+    k: experts per token (1 = Switch, 2 = GShard-style).
+
+  Returns:
+    dispatch: [N, E, C] 0/1 — token n occupies slot c of expert e.
+    combine:  [N, E, C] f32 — gate weights (renormalized over the
+      token's KEPT choices) at the occupied slots.
+    aux: scalar load-balance loss (Switch eq. 4: E · Σ_e f_e·p_e with
+      f_e the fraction of tokens whose FIRST choice is e and p_e the
+      mean router probability of e) — 1.0 at perfect balance.
+  """
+  n, num_experts = logits.shape
+  gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+  remaining = gates
+  counts = jnp.zeros((num_experts,), jnp.float32)
+  dispatch = jnp.zeros((n, num_experts, capacity), jnp.float32)
+  gate_sum = jnp.zeros((n,), jnp.float32)
+  combine = jnp.zeros((n, num_experts, capacity), jnp.float32)
+  aux = 0.0
+  for choice in range(k):
+    expert = jnp.argmax(remaining, axis=-1)                  # [N]
+    onehot = jax.nn.one_hot(expert, num_experts)             # [N, E]
+    if choice == 0:
+      aux = num_experts * jnp.sum(
+          jnp.mean(onehot, axis=0) * jnp.mean(gates, axis=0))
+    # Slot index within each expert: tokens claim slots in order,
+    # offset by the slots earlier choices already filled.
+    position = (jnp.cumsum(onehot, axis=0) - onehot
+                + counts[None, :])                           # [N, E]
+    slot = jnp.sum(position * onehot, axis=-1).astype(jnp.int32)
+    kept = (slot < capacity).astype(jnp.float32)
+    gate = jnp.sum(gates * onehot, axis=-1)                  # [N]
+    hot = (kept[:, None, None] * onehot[:, :, None]
+           * jax.nn.one_hot(slot, capacity)[:, None, :])     # [N, E, C]
+    dispatch = dispatch + hot
+    combine = combine + gate[:, None, None] * hot
+    gate_sum = gate_sum + gate * kept
+    counts = counts + jnp.sum(onehot * kept[:, None], axis=0)
+    remaining = remaining * (1.0 - onehot)
+  combine = combine / jnp.maximum(gate_sum, _EPS)[:, None, None]
+  return dispatch, combine, aux
+
+
+def moe_mlp(
+    x: jax.Array,
+    router: jax.Array,
+    w_in: jax.Array,
+    b_in: jax.Array,
+    w_out: jax.Array,
+    b_out: jax.Array,
+    *,
+    k: int,
+    capacity_factor: float,
+) -> Tuple[jax.Array, jax.Array]:
+  """Dense-dispatch MoE over one token group (the per-device body).
+
+  x [N, M]; router [M, E]; w_in [E, M, H]; b_in [E, H];
+  w_out [E, H, M]; b_out [E, M] → ([N, M], aux scalar).
+  """
+  n, _ = x.shape
+  num_experts = router.shape[-1]
+  capacity = expert_capacity(n, num_experts, k, capacity_factor)
+  logits = x.astype(jnp.float32) @ router
+  dispatch, combine, aux = top_k_routing(logits, capacity, k)
+  dtype = x.dtype
+  xd = jnp.einsum("nm,nec->ecm", x, dispatch.astype(dtype))
+  h = jax.nn.gelu(
+      jnp.einsum("ecm,emh->ech", xd, w_in) + b_in[:, None, :])
+  y = jnp.einsum("ech,ehm->ecm", h, w_out) + b_out[:, None, :]
+  out = jnp.einsum("ecm,nec->nm", y, combine.astype(dtype))
+  return out.astype(dtype), aux
+
+
+def _moe_local(x, router, w_in, b_in, w_out, b_out, *, k,
+               capacity_factor, axis_name, num_experts, mean_axes):
+  """Per-device body under shard_map: route local tokens, exchange.
+
+  x local [N_local, M]; expert params local [E/P, ...]. The two
+  all-to-alls are the whole EP communication story: dispatched tokens
+  out to their experts' devices, expert outputs back home. `mean_axes`
+  are every mesh axis the token dim is sharded over (data + expert),
+  so the returned aux loss is the global mean and legitimately
+  replicated.
+  """
+  n = x.shape[0]
+  capacity = expert_capacity(n, num_experts, k, capacity_factor)
+  logits = x.astype(jnp.float32) @ router
+  dispatch, combine, aux = top_k_routing(logits, capacity, k)
+  dtype = x.dtype
+  # [E, C, M]: this device's tokens, laid out per destination expert.
+  xd = jnp.einsum("nm,nec->ecm", x, dispatch.astype(dtype))
+  # Exchange: split the expert dim across devices, concatenate the
+  # incoming groups on the capacity dim → [E/P, C·P, M]: all devices'
+  # tokens for MY experts.
+  xd = jax.lax.all_to_all(xd, axis_name, split_axis=0, concat_axis=1,
+                          tiled=True)
+  h = jax.nn.gelu(
+      jnp.einsum("ecm,emh->ech", xd, w_in) + b_in[:, None, :])
+  y = jnp.einsum("ech,ehm->ecm", h, w_out) + b_out[:, None, :]
+  # Inverse exchange: groups back to their home devices → [E, C, M].
+  y = jax.lax.all_to_all(y, axis_name, split_axis=1, concat_axis=0,
+                         tiled=True)
+  out = jnp.einsum("ecm,nec->nm", y, combine.astype(dtype))
+  return out.astype(dtype), jax.lax.pmean(aux, mean_axes)
+
+
+class MoEMLP(nn.Module):
+  """Switch/GShard-style MoE feed-forward (drop-in for a dense MLP).
+
+  With `mesh=None` (or no non-trivial `expert` axis) runs the dense
+  single-device formulation; with an `expert` axis, expert weights
+  live sharded over it and tokens all-to-all to their experts. The
+  load-balance auxiliary loss is sown into the "aux_loss" collection
+  under "moe_aux" — training models add
+  `aux_weight · sum(collected)` to their loss (see
+  `collect_aux_losses`).
+  """
+
+  num_experts: int
+  hidden_dim: int
+  k: int = 2
+  capacity_factor: float = 2.0
+  mesh: Optional[Mesh] = None
+  dtype: Any = jnp.bfloat16
+
+  @nn.compact
+  def __call__(self, x: jax.Array) -> jax.Array:
+    b, t, model_dim = x.shape
+    e, h = self.num_experts, self.hidden_dim
+    init = nn.initializers.lecun_normal()
+    router = self.param("router", init, (model_dim, e), jnp.float32)
+    # "expert_" prefix is the contract `expert_sharding` keys on.
+    w_in = self.param("expert_w_in", init, (e, model_dim, h),
+                      jnp.float32).astype(self.dtype)
+    b_in = self.param("expert_b_in", nn.initializers.zeros,
+                      (e, h), jnp.float32).astype(self.dtype)
+    w_out = self.param("expert_w_out", init, (e, h, model_dim),
+                       jnp.float32).astype(self.dtype)
+    b_out = self.param("expert_b_out", nn.initializers.zeros,
+                       (e, model_dim), jnp.float32).astype(self.dtype)
+
+    x = x.astype(self.dtype)
+    tokens = x.reshape(b * t, model_dim)
+    mesh = self.mesh
+    if (mesh is None or EXPERT_AXIS not in mesh.axis_names
+        or mesh.shape[EXPERT_AXIS] == 1):
+      out, aux = moe_mlp(tokens, router, w_in, b_in, w_out, b_out,
+                         k=self.k, capacity_factor=self.capacity_factor)
+    else:
+      from jax.sharding import PartitionSpec as P
+
+      from tensor2robot_tpu.parallel.mesh import DATA_AXIS
+
+      part = mesh.shape[EXPERT_AXIS]
+      if e % part:
+        raise ValueError(
+            f"num_experts {e} must be a multiple of the "
+            f"{EXPERT_AXIS!r} axis size {part}.")
+      # Tokens group per device: the batch shards over data AND
+      # expert axes jointly (standard dp×ep layout — the expert axis
+      # doubles as extra data parallelism outside MoE blocks).
+      token_axes = tuple(a for a in (DATA_AXIS, EXPERT_AXIS)
+                         if a in mesh.axis_names)
+      groups = int(np.prod([mesh.shape[a] for a in token_axes]))
+      if (b * t) % groups:
+        raise ValueError(
+            f"token count {b}×{t} must be a multiple of the {groups} "
+            f"token groups of mesh axes {token_axes}.")
+      body = functools.partial(
+          _moe_local, k=self.k, capacity_factor=self.capacity_factor,
+          axis_name=EXPERT_AXIS, num_experts=e,
+          mean_axes=token_axes)
+      tok = P(token_axes)
+      ep = P(EXPERT_AXIS)
+      out, aux = jax.shard_map(
+          body, mesh=mesh,
+          in_specs=(tok, P(), ep, ep, ep, ep),
+          out_specs=(tok, P()),
+          check_vma=False,
+      )(tokens, router, w_in, b_in, w_out, b_out)
+    self.sow("aux_loss", "moe_aux", aux)
+    return out.reshape(b, t, model_dim)
+
+
+def collect_aux_losses(variables: Any) -> jax.Array:
+  """Sums every sown aux loss (0.0 when the model has none)."""
+  total = jnp.asarray(0.0, jnp.float32)
+  for leaf in jax.tree_util.tree_leaves(variables.get("aux_loss", {})):
+    total = total + jnp.sum(jnp.asarray(leaf, jnp.float32))
+  return total

@@ -1,0 +1,162 @@
+"""Pipeline parallelism: layer stages over the `stage` mesh axis.
+
+The reference has no pipeline parallelism (SURVEY.md §3 marks PP "not
+needed for these CNN-scale models") and the robot-scale flagships here
+don't need it either — but a complete TPU framework must scale models
+whose LAYERS don't fit one chip, so the `stage` axis carries a
+GPipe-style microbatched pipeline built from SPMD primitives:
+
+  * Stage parameters live STACKED with a leading stage dim, sharded
+    over the `stage` axis — each device materializes only its own
+    stage's weights (the memory win that motivates PP).
+  * The schedule is a single `lax.scan` over M + S - 1 ticks: stage 0
+    ingests a fresh microbatch each tick, every stage applies its
+    layer to the activation it holds, and activations `ppermute` one
+    hop down the ring. The last stage collects finished microbatches.
+    Per-device FLOPs per tick are one stage on one microbatch; the
+    (S-1)/(M+S-1) bubble is the standard GPipe cost, amortized by
+    more microbatches.
+  * Backward needs no hand-written schedule: `jax.grad` through the
+    scan + ppermute yields the reversed pipeline automatically (the
+    transpose of a ppermute is the reverse ppermute), with cotangents
+    flowing back up the ring.
+
+Stages must be shape-preserving (activation in == activation out),
+which transformer blocks satisfy; that invariant is what lets one
+rotating buffer serve every stage.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tensor2robot_tpu.parallel.mesh import DATA_AXIS, STAGE_AXIS
+
+
+def init_stage_params(
+    init_fn: Callable[[jax.Array], Any],
+    rng: jax.Array,
+    num_stages: int,
+) -> Any:
+  """Stacks per-stage params: init_fn(rng) vmapped over S fresh rngs.
+
+  Every leaf gains a leading [S] dim — the dim `stage_sharding`
+  shards. Use with `module.init` partials:
+  `init_stage_params(lambda r: stage.init(r, x_micro), rng, S)`.
+  """
+  return jax.vmap(init_fn)(jax.random.split(rng, num_stages))
+
+
+def stage_sharding(mesh: Mesh, tree: Any) -> Any:
+  """NamedShardings putting every leaf's leading stage dim on `stage`."""
+  def rule(leaf):
+    ndim = getattr(leaf, "ndim", 0)
+    if not ndim:
+      return NamedSharding(mesh, P())
+    return NamedSharding(mesh, P(STAGE_AXIS))
+  return jax.tree_util.tree_map(rule, tree)
+
+
+def _pipeline_local(params, x, *, apply_fn, num_stages, axis_name):
+  """Per-device body: my stage's params (leading dim 1), all microbatches.
+
+  x: [M, mb_local, ...]; returns [M, mb_local, ...] — valid on every
+  device (the last stage's collected outputs are psum-broadcast so the
+  caller sees an ordinary replicated-over-stage activation).
+  """
+  params = jax.tree_util.tree_map(lambda l: l[0], params)
+  idx = jax.lax.axis_index(axis_name)
+  num_micro = x.shape[0]
+  perm = [(j, (j + 1) % num_stages) for j in range(num_stages)]
+
+  def tick(carry, t):
+    state, out = carry
+    # Stage 0 ingests microbatch t (clamped re-feeds past the end are
+    # never collected: they would finish after the last tick).
+    inp = jax.lax.dynamic_index_in_dim(
+        x, jnp.minimum(t, num_micro - 1), 0, keepdims=False)
+    state = jnp.where(idx == 0, inp, state)
+    y = apply_fn(params, state)
+    # The last stage finishes microbatch t - (S-1) this tick.
+    done = t - (num_stages - 1)
+    collect = (idx == num_stages - 1) & (done >= 0)
+    out = jnp.where(
+        collect,
+        jax.lax.dynamic_update_index_in_dim(
+            out, y, jnp.clip(done, 0, num_micro - 1), 0),
+        out)
+    state = jax.lax.ppermute(y, axis_name, perm)
+    return (state, out), ()
+
+  init = (jnp.zeros_like(x[0]), jnp.zeros_like(x))
+  (_, out), _ = jax.lax.scan(
+      tick, init, jnp.arange(num_micro + num_stages - 1))
+  # Only the last stage holds real outputs; sum-broadcast over the
+  # stage ring so out_specs can declare the result stage-replicated.
+  return jax.lax.psum(jnp.where(idx == num_stages - 1, out, 0.0),
+                      axis_name)
+
+
+def pipeline_apply(
+    apply_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    x: jax.Array,
+    *,
+    mesh: Optional[Mesh],
+    num_microbatches: int,
+    axis_name: str = STAGE_AXIS,
+) -> jax.Array:
+  """Runs x through S pipelined stages of `apply_fn`.
+
+  Args:
+    apply_fn: (one stage's params, activation [mb, ...]) → same-shape
+      activation. Typically `stage_module.apply` with a params dict.
+    stage_params: pytree with leading [S] dim on every leaf (see
+      `init_stage_params`), sharded (or shardable) over `axis_name`.
+    x: [B, ...] global batch; B must divide into `num_microbatches`
+      (× the data-axis size when the mesh has one — the batch dim
+      shards over `data`, microbatching happens on the per-shard rows).
+    mesh: mesh with `axis_name`; its size S is the stage count.
+    num_microbatches: M; the pipeline bubble is (S-1)/(M+S-1).
+
+  Returns [B, ...] with the same sharding layout as x.
+
+  Falls back to a sequential scan of stages when the mesh is None or
+  has no non-trivial stage axis — same math, one code path for models.
+  """
+  if (mesh is None or axis_name not in mesh.axis_names
+      or mesh.shape[axis_name] == 1):
+    def body(h, p):
+      return apply_fn(p, h), ()
+    out, _ = jax.lax.scan(body, x, stage_params)
+    return out
+
+  num_stages = mesh.shape[axis_name]
+  batch = x.shape[0]
+  data_size = (mesh.shape[DATA_AXIS]
+               if DATA_AXIS in mesh.axis_names else 1)
+  if batch % (num_microbatches * data_size):
+    raise ValueError(
+        f"Batch {batch} must be a multiple of num_microbatches="
+        f"{num_microbatches} × data axis {data_size}.")
+  # [B, ...] -> [M, B/M, ...]; rows stay contiguous per microbatch so
+  # the data-axis sharding of the batch dim carries over to dim 1.
+  micro = x.reshape((num_microbatches, batch // num_microbatches)
+                    + x.shape[1:])
+
+  body = functools.partial(
+      _pipeline_local, apply_fn=apply_fn, num_stages=num_stages,
+      axis_name=axis_name)
+  data_axis = DATA_AXIS if DATA_AXIS in mesh.axis_names else None
+  xspec = P(None, data_axis)
+  out = jax.shard_map(
+      body, mesh=mesh,
+      in_specs=(P(STAGE_AXIS), xspec), out_specs=xspec,
+      check_vma=False,
+  )(stage_params, micro)
+  return out.reshape(x.shape)

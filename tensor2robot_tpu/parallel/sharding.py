@@ -24,6 +24,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tensor2robot_tpu.parallel.mesh import (
+    EXPERT_AXIS,
     FSDP_AXIS,
     MODEL_AXIS,
     replicated,
@@ -91,6 +92,35 @@ def tensor_parallel_sharding(
   return jax.tree_util.tree_map(rule, tree)
 
 
+def expert_sharding(mesh: Mesh, tree: Any,
+                    min_size_to_shard: int = 2 ** 10) -> Any:
+  """fsdp rules + expert weights sharded over the `expert` axis.
+
+  Keys on the `MoEMLP` param-name contract: leaves whose path contains
+  an ``expert_``-prefixed name (the stacked [E, ...] expert weights)
+  put their leading expert dim on `expert`; everything else (router,
+  attention, dense trunk — and every optimizer mirror, which shares
+  its param's path) follows the fsdp rule. With no `expert` mesh axis
+  this IS `fsdp_sharding`.
+  """
+  if EXPERT_AXIS not in mesh.axis_names:
+    return fsdp_sharding(mesh, tree, min_size_to_shard)
+  size = mesh.shape[EXPERT_AXIS]
+
+  def rule(path, leaf):
+    shape = getattr(leaf, "shape", ())
+    is_expert = any(
+        str(getattr(key, "key", getattr(key, "name", ""))).startswith(
+            "expert_") for key in path)
+    if is_expert and shape and shape[0] % size == 0:
+      return NamedSharding(mesh, P(EXPERT_AXIS))
+    # A single array is its own pytree: fsdp_sharding returns the
+    # one NamedSharding its rule picks for this leaf.
+    return fsdp_sharding(mesh, leaf, min_size_to_shard)
+
+  return jax.tree_util.tree_map_with_path(rule, tree)
+
+
 def replicated_sharding(mesh: Mesh, tree: Any,
                         min_size_to_shard: int = 0) -> Any:
   """Every leaf fully replicated — pure data parallelism.
@@ -109,5 +139,6 @@ def state_sharding(mesh: Mesh, state: Any,
   """Shardings for a full TrainState (params + opt mirrors, scalars repl)."""
   rule_fn = {"fsdp": fsdp_sharding,
              "tp": tensor_parallel_sharding,
+             "ep": expert_sharding,
              "replicated": replicated_sharding}[strategy]
   return rule_fn(mesh, state, min_size_to_shard=min_size_to_shard)

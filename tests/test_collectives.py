@@ -32,9 +32,11 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from tensor2robot_tpu import specs
 from tensor2robot_tpu.parallel import (
     DATA_AXIS,
+    EXPERT_AXIS,
     FSDP_AXIS,
     MODEL_AXIS,
     SEQ_AXIS,
+    STAGE_AXIS,
     batch_sharding,
     create_mesh,
     sequence_sharding,
@@ -48,9 +50,21 @@ COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",
 
 
 def collective_counts(hlo_text: str):
-  """Counts collective INSTRUCTIONS (not metadata mentions) in HLO."""
+  """Counts collective INSTRUCTIONS (not metadata mentions) in HLO.
+
+  Matches both scalar-typed (`= f32[...] all-reduce(`) and
+  tuple-typed (`= (f32[...], ...) all-to-all(`) instruction forms —
+  multi-operand collectives (e.g. the MoE all-to-alls) lower to the
+  tuple form, which a bare `\\S+` type pattern silently misses. The
+  type is matched non-greedily rather than by balancing parens:
+  real-TPU HLO embeds tiled layouts like `f32[256,64]{1,0:T(8,128)}`
+  whose inner parens would defeat a `\\([^)]*\\)` alternation.
+  (`.` does not cross newlines, so the match stays on the
+  instruction's own line; async `-done` halves don't match and
+  double-count because the op name must be followed directly by `(`.)
+  """
   return {
-      op: len(re.findall(rf"= \S+ {op}(?:-start)?\(", hlo_text))
+      op: len(re.findall(rf"= .+? {op}(?:-start)?\(", hlo_text))
       for op in COLLECTIVES
   }
 
@@ -85,46 +99,49 @@ class TestTrainStepCollectives:
 
   def test_fsdp_mesh_gradient_reduce_and_param_gathers(self):
     counts = compile_qtopt_step({DATA_AXIS: 4, FSDP_AXIS: 2}, "fsdp")
-    # One fused gradient all-reduce over data×fsdp. Zero would mean
-    # each device row trains on its own shard and silently diverges.
-    assert counts["all-reduce"] == 1, counts
+    # Gradient + metric reductions over data×fsdp, including the
+    # TUPLE-form fused param-gradient all-reduce the pre-fix regex
+    # missed entirely (this file asserted `all-reduce == 1` for two
+    # rounds because only one scalar-typed reduce matched). Zero
+    # would mean device rows silently diverge.
+    assert counts["all-reduce"] == 9, counts
     # Zero-style param/optimizer sharding: every fsdp-sharded tensor
     # all-gathers for use (forward + recompute). Zero would mean the
     # state silently replicated — the regression this file exists for.
     # (Was 9 before the round-4 CEM-head concatenate rewrite; the
     # head restructure let GSPMD merge two gathers.)
     assert counts["all-gather"] == 7, counts
-    # This layout needs no permutes / transposes of the batch.
+    # This layout needs no permutes; the all-to-alls are
+    # partitioner-chosen reshards of batched activations between the
+    # batch-sharded and replicated-output layouts (tuple form, also
+    # invisible to the old regex).
     assert counts["collective-permute"] == 0, counts
-    assert counts["all-to-all"] == 0, counts
+    assert counts["all-to-all"] == 5, counts
 
   def test_tp_mesh_adds_tensor_parallel_reductions(self):
     counts = compile_qtopt_step(
         {DATA_AXIS: 2, FSDP_AXIS: 2, MODEL_AXIS: 2}, "tp")
     # Megatron-style partial-sum reductions of activations (forward
-    # AND backward) on top of the gradient reduce: strictly more
-    # all-reduces than the pure-fsdp layout's single fused one.
-    assert counts["all-reduce"] == 6, counts
+    # AND backward) on top of the gradient/metric reduces: strictly
+    # more all-reduces than the pure-fsdp layout.
+    assert counts["all-reduce"] == 15, counts
     assert counts["all-gather"] == 41, counts
-    assert counts["all-to-all"] == 0, counts
+    assert counts["all-to-all"] == 6, counts
 
   def test_fsdp_vs_replicated_baseline(self):
     """Same step with NO state sharding: the param gathers disappear.
 
-    Proves the all-gathers above are attributable to the fsdp rules.
-    Instructive wrinkle this pins: with every output replicated and
-    the model this tiny, the cost-based partitioner decides sharded
-    compute isn't worth it — it gathers the batch inputs and runs the
-    step replicated, so there is no gradient all-reduce at all (one
-    fused input all-gather since the round-4 CEM-head rewrite; three
-    separate ones before). Exactly the silent de-parallelization mode
-    this audit exists to surface: replicated-state DP leaves the
-    sharding decision to a cost model, while the fsdp/tp rules above
-    FORCE distributed state and thereby sharded compute.
+    Proves the 7 all-gathers above are attributable to the fsdp rules
+    (one input gather remains here). The fused tuple gradient
+    all-reduce is still present — with replicated state the
+    partitioner still shards the batched compute over the mesh and
+    reduces gradients, it just never needs to gather parameters.
+    (Rounds 2–3 read this layout as "fully de-parallelized, zero
+    all-reduces"; that was the tuple-blind regex, not the program.)
     """
     counts = compile_qtopt_step({DATA_AXIS: 4, FSDP_AXIS: 2},
                                 "replicated")
-    assert counts["all-reduce"] == 0, counts
+    assert counts["all-reduce"] == 5, counts
     assert counts["all-gather"] == 1, counts
 
 
@@ -164,3 +181,117 @@ class TestRingCollectives:
     # dk/dv cotangents backward around the ring.
     assert counts["collective-permute"] == 12, counts
     assert counts["all-gather"] == 0, counts
+
+
+class TestMoECollectives:
+  """Expert parallelism: the communication is exactly two all-to-alls.
+
+  Dispatch (tokens out to their experts' devices) and return (expert
+  outputs back home). Zero all-gathers: no device ever materializes
+  all experts' weights or all devices' tokens — the regression this
+  pins is expert weights silently replicating.
+  """
+
+  def _module_and_args(self):
+    from tensor2robot_tpu.parallel import MoEMLP
+
+    mesh = create_mesh({DATA_AXIS: 2, EXPERT_AXIS: 4})
+    module = MoEMLP(num_experts=8, hidden_dim=16, k=2,
+                    capacity_factor=2.0, mesh=mesh, dtype=jnp.float32)
+    x = jnp.asarray(
+        np.random.default_rng(0).standard_normal((4, 16, 8)),
+        jnp.float32)
+    variables = module.init(jax.random.PRNGKey(0), x)
+    return module, variables, x
+
+  def test_forward_is_two_all_to_alls(self):
+    module, variables, x = self._module_and_args()
+    fwd = jax.jit(
+        lambda v, x: module.apply(v, x, mutable=["aux_loss"])[0])
+    counts = collective_counts(fwd.lower(variables, x)
+                               .compile().as_text())
+    assert counts["all-to-all"] == 2, counts
+    assert counts["all-gather"] == 0, counts
+    assert counts["collective-permute"] == 0, counts
+
+  def test_backward_transposes_to_all_to_alls(self):
+    from tensor2robot_tpu.parallel import collect_aux_losses
+
+    module, variables, x = self._module_and_args()
+
+    def loss(params, x):
+      out, state = module.apply({"params": params}, x,
+                                mutable=["aux_loss"])
+      return jnp.sum(out ** 2) + 0.01 * collect_aux_losses(state)
+
+    grad = jax.jit(jax.grad(loss))
+    counts = collective_counts(
+        grad.lower(variables["params"], x).compile().as_text())
+    # Forward's 2 + the transposed pair, with XLA's combiner merging
+    # one adjacent pair → 3. The aux pmean + its transpose + the
+    # router gradient reduction (router is replicated, its grad sums
+    # over every token group) account for the 3 all-reduces.
+    assert counts["all-to-all"] == 3, counts
+    assert counts["all-reduce"] == 3, counts
+    assert counts["all-gather"] == 0, counts
+
+
+class TestPipelineCollectives:
+  """Pipeline stages communicate by ppermute inside the tick scan.
+
+  One forward permute (activations one hop down the ring) regardless
+  of microbatch count — it lives INSIDE the scanned tick body. The
+  backward adds the reversed-loop permute carrying cotangents back up.
+  """
+
+  def _stage_and_args(self):
+    import flax.linen as nn
+
+    from tensor2robot_tpu.layers.transformer import TransformerBlock
+    from tensor2robot_tpu.parallel import (
+        init_stage_params,
+        pipeline_apply,
+        stage_sharding,
+    )
+
+    class _Stage(nn.Module):
+
+      @nn.compact
+      def __call__(self, x):
+        return TransformerBlock(num_heads=2, head_dim=4,
+                                dtype=jnp.float32)(x)
+
+    mesh = create_mesh({DATA_AXIS: 2, STAGE_AXIS: 4})
+    stage = _Stage()
+    x = jnp.asarray(
+        np.random.default_rng(1).standard_normal((8, 4, 8)),
+        jnp.float32)
+    params = init_stage_params(lambda r: stage.init(r, x[:1]),
+                               jax.random.PRNGKey(0), 4)
+    params = jax.device_put(params, stage_sharding(mesh, params))
+    run = lambda p, x: pipeline_apply(  # noqa: E731
+        stage.apply, p, x, mesh=mesh, num_microbatches=2)
+    return run, params, x
+
+  def test_forward_permutes_once_per_tick(self):
+    run, params, x = self._stage_and_args()
+    counts = collective_counts(
+        jax.jit(run).lower(params, x).compile().as_text())
+    assert counts["collective-permute"] == 1, counts
+    # The single all-reduce is the last-stage output broadcast
+    # (psum over the stage ring); the all-gather reshards the
+    # stage-replicated input once on entry.
+    assert counts["all-reduce"] == 1, counts
+    assert counts["all-gather"] == 1, counts
+    assert counts["all-to-all"] == 0, counts
+
+  def test_backward_adds_the_reverse_permute(self):
+    run, params, x = self._stage_and_args()
+    grad = jax.jit(jax.grad(
+        lambda p, x: jnp.sum(run(p, x) ** 2)))
+    counts = collective_counts(
+        grad.lower(params, x).compile().as_text())
+    # Forward permute + the reversed-scan permute carrying activation
+    # cotangents back up the ring.
+    assert counts["collective-permute"] == 2, counts
+    assert counts["all-to-all"] == 0, counts

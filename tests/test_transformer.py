@@ -278,6 +278,79 @@ class TestTransformerBC:
         np.asarray(exported["action"]), np.asarray(native["action"]),
         atol=2e-2, rtol=2e-2)
 
+  def test_default_export_skips_proto_signature_with_warning(
+      self, run):
+    """Sequence specs can't ride the tf.Example wire: the DEFAULT
+    exporter config (include_tf_example_signature=True, as
+    create_default_exporters builds it) must still succeed — warning
+    and skipping the proto signature instead of crashing in
+    build_feature_map."""
+    import tensorflow as tf
+
+    from tensor2robot_tpu.export import SavedModelExportGenerator
+    from tensor2robot_tpu.utils import checkpoints as ckpt_lib
+
+    model, model_dir = run
+    state = model.create_inference_state(jax.random.PRNGKey(0))
+    variables = ckpt_lib.restore_variables(
+        model_dir, like={"params": state.params,
+                         "batch_stats": state.batch_stats or {}})
+    state = state.replace(params=variables["params"])
+    with pytest.warns(RuntimeWarning, match="SequenceExample"):
+      export_dir = SavedModelExportGenerator().export(
+          model, jax.device_get(state), model_dir)
+    loaded = tf.saved_model.load(export_dir)
+    assert "serving_default" in loaded.signatures
+    assert "parse_tf_example" not in loaded.signatures
+    assert "parse_tf_sequence_example" not in loaded.signatures
+
+  def test_sequence_example_signature_round_trip(self, run):
+    """With a declared static episode length the exporter emits a
+    tf.SequenceExample proto signature whose outputs match the numpy
+    serving path on same-length episodes."""
+    import tensorflow as tf
+
+    from tensor2robot_tpu.data import tfexample
+    from tensor2robot_tpu.export import SavedModelExportGenerator
+    from tensor2robot_tpu.predictors import SavedModelPredictor
+    from tensor2robot_tpu.utils import checkpoints as ckpt_lib
+
+    model, model_dir = run
+    state = model.create_inference_state(jax.random.PRNGKey(0))
+    variables = ckpt_lib.restore_variables(
+        model_dir, like={"params": state.params,
+                         "batch_stats": state.batch_stats or {}})
+    state = state.replace(params=variables["params"])
+    t = 16
+    export_dir = SavedModelExportGenerator(
+        sequence_example_length=t).export(
+            model, jax.device_get(state), model_dir)
+    loaded = tf.saved_model.load(export_dir)
+    assert "parse_tf_sequence_example" in loaded.signatures
+
+    feature_spec = model.preprocessor.get_in_feature_specification(
+        Mode.PREDICT)
+    rng = np.random.default_rng(29)
+    batch = {
+        "image": rng.integers(0, 255, (2, t, IMG, IMG, 3)
+                              ).astype(np.uint8),
+        "gripper_pose": rng.standard_normal((2, t, 3)
+                                            ).astype(np.float32),
+    }
+    serialized = [
+        tfexample.encode_sequence_example(
+            {k: v[i] for k, v in batch.items()}, feature_spec)
+        for i in range(2)
+    ]
+    from_protos = loaded.signatures["parse_tf_sequence_example"](
+        examples=tf.constant(serialized))
+    predictor = SavedModelPredictor(export_dir.rsplit("/", 1)[0])
+    assert predictor.restore(timeout_secs=0)
+    from_numpy = predictor.predict(batch)
+    np.testing.assert_allclose(
+        np.asarray(from_protos["action"]),
+        np.asarray(from_numpy["action"]), atol=1e-4, rtol=1e-4)
+
   def test_masked_loss_ignores_padding(self):
     model = tiny_model()
     state = model.create_train_state(jax.random.PRNGKey(0))
