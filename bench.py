@@ -434,12 +434,15 @@ def bench_long_context(t: int = 32768, heads: int = 4, d: int = 64,
 
 
 def bench_input_pipeline(batch_size: int = 256, image_size: int = 64,
-                         num_records: int = 2048, batches: int = 40):
-  """Host tf.data pipeline rate at the bench config (jpeg decode).
+                         num_records: int = 2048, batches: int = 40,
+                         image_format: str = "jpeg"):
+  """Host tf.data pipeline rate at the bench config.
 
   The question the number answers: can ONE host feed one chip's
   measured Bellman-step rate at the bench batch size? (SURVEY §4.3 —
   parse + decode run inside the tf.data graph under AUTOTUNE.)
+  `image_format="raw"` measures the decode_raw wire (disk-for-CPU
+  trade) against the same pipeline, isolating the codec cost.
   """
   import os
   import tempfile
@@ -456,7 +459,7 @@ def bench_input_pipeline(batch_size: int = 256, image_size: int = 64,
   spec = TensorSpecStruct()
   spec.image = ExtendedTensorSpec(
       shape=(image_size, image_size, 3), dtype=np.uint8, name="image",
-      data_format="jpeg")
+      data_format=image_format)
   spec.action = ExtendedTensorSpec(shape=(4,), dtype=np.float32,
                                    name="action")
   rng = np.random.default_rng(0)
@@ -480,8 +483,8 @@ def bench_input_pipeline(batch_size: int = 256, image_size: int = 64,
       next(it)
     rate = batches / (time.perf_counter() - t0)
   return {
-      "config": (f"batch={batch_size}, {image_size}x{image_size} jpeg "
-                 f"decode in tf.data graph (AUTOTUNE)"),
+      "config": (f"batch={batch_size}, {image_size}x{image_size} "
+                 f"{image_format} decode in tf.data graph (AUTOTUNE)"),
       "batches_per_sec": round(rate, 2),
       "images_per_sec": round(rate * batch_size, 1),
   }
@@ -502,11 +505,21 @@ def main():
       detail = json.load(f)
   except (OSError, ValueError):
     pass
-  detail["primary"] = bench_config(False, profile_dir=profile_dir)
+  def keep_top_ops(old, new):
+    """Unprofiled runs must not erase the last profiled per-op table."""
+    if old and "top_ops" in old and "top_ops" not in new:
+      new["top_ops"] = old["top_ops"]
+      new["top_ops_from_prior_profiled_run"] = True
+    return new
+
+  detail["primary"] = keep_top_ops(
+      detail.get("primary"),
+      bench_config(False, profile_dir=profile_dir))
   if run_paper:
-    detail["paper_scale"] = bench_config(
-        True, profile_dir=(profile_dir + "_paper") if profile_dir
-        else None)
+    detail["paper_scale"] = keep_top_ops(
+        detail.get("paper_scale"),
+        bench_config(True, profile_dir=(profile_dir + "_paper")
+                     if profile_dir else None))
     detail["paper_scale_mxu_width"] = bench_config(True, width=128)
   steps = detail["primary"]["steps_per_sec_best"]
   if "--input" in args:
@@ -515,6 +528,10 @@ def main():
         detail["input_pipeline"]["batches_per_sec"] >= steps)
     detail["input_pipeline"]["pod_fan_out"] = _pod_feed_math(
         detail["input_pipeline"]["images_per_sec"], steps)
+    raw = bench_input_pipeline(image_format="raw")
+    raw["feeds_chip"] = bool(raw["batches_per_sec"] >= steps)
+    raw["pod_fan_out"] = _pod_feed_math(raw["images_per_sec"], steps)
+    detail["input_pipeline_raw"] = raw
   if "--replay" in args:
     detail["replay_pipeline"] = bench_replay_pipeline(steps)
   if "--longcontext" in args:

@@ -31,6 +31,19 @@ def wire_key(key: str, spec: ExtendedTensorSpec) -> str:
   return spec.name or key
 
 
+def _is_raw(spec: ExtendedTensorSpec) -> bool:
+  """Raw-bytes wire: one bytes feature holding the C-order array.
+
+  `data_format="raw"` trades disk for host CPU — parse is a near-memcpy
+  `decode_raw` instead of a jpeg/png codec, which is what lets a
+  few-core host feed a chip at full step rate (BENCH_DETAIL.json
+  `input_pipeline` measures the decode path as the feed bottleneck).
+  Byte order is little-endian (every supported platform; decode_raw's
+  default).
+  """
+  return spec.data_format == "raw"
+
+
 def build_feature_map(feature_spec: Any) -> Dict[str, Any]:
   """Derives the tf.io.parse_example feature map from a spec structure."""
   tf = _tf()
@@ -38,8 +51,19 @@ def build_feature_map(feature_spec: Any) -> Dict[str, Any]:
   feature_map: Dict[str, Any] = {}
   for key, spec in flat.items():
     name = wire_key(key, spec)
-    if spec.is_image:
-      # Encoded images are stored as variable-length byte strings.
+    # The sequence guard comes FIRST: image/raw sequence specs must
+    # hit the clear SequenceExample error too, not silently bind one
+    # byte string per example (which would fuse the time axis into
+    # the wire blob).
+    if spec.is_sequence:
+      raise ValueError(
+          f"Sequence spec {name!r} cannot be bound to a tf.Example wire "
+          f"directly; episode data travels as tf.SequenceExample — use "
+          f"parse_sequence_example_batch / encode_sequence_example — or "
+          f"materialize a fixed length first via "
+          f"specs.add_sequence_length (XLA needs static shapes).")
+    if spec.is_image or _is_raw(spec):
+      # Encoded images / raw array bytes travel as one byte string.
       feature_map[name] = tf.io.FixedLenFeature([], tf.string)
       continue
     dtype = np.dtype(spec.dtype)
@@ -49,13 +73,6 @@ def build_feature_map(feature_spec: Any) -> Dict[str, Any]:
       tf_dtype = tf.int64
     else:
       raise ValueError(f"Unsupported spec dtype for tf.Example: {dtype}")
-    if spec.is_sequence:
-      raise ValueError(
-          f"Sequence spec {name!r} cannot be bound to a tf.Example wire "
-          f"directly; episode data travels as tf.SequenceExample — use "
-          f"parse_sequence_example_batch / encode_sequence_example — or "
-          f"materialize a fixed length first via "
-          f"specs.add_sequence_length (XLA needs static shapes).")
     if spec.varlen:
       # Ragged on the wire; padded/truncated to the static shape at parse
       # time.
@@ -106,6 +123,10 @@ def parse_example_batch(
           for b in value.numpy()])
       out[key] = images.astype(spec.dtype)
       continue
+    if _is_raw(spec):
+      out[key] = np.stack([
+          _fit_raw(b, spec, key) for b in value.numpy()])
+      continue
     if spec.varlen:
       dense = tf.sparse.to_dense(value).numpy()
       out[key] = _pad_or_truncate(dense, spec, batch_size)
@@ -113,6 +134,19 @@ def parse_example_batch(
     arr = value.numpy().reshape((batch_size,) + tuple(spec.shape))
     out[key] = arr.astype(spec.dtype)
   return TensorSpecStruct.from_flat_dict(out)
+
+
+def _fit_raw(data: bytes, spec: ExtendedTensorSpec,
+             key: str) -> np.ndarray:
+  """Decodes one raw-wire byte string, naming the spec on mismatch."""
+  dtype = np.dtype(spec.dtype)
+  expected = int(np.prod(spec.shape)) * dtype.itemsize
+  if len(data) != expected:
+    raise ValueError(
+        f"Raw feature {key!r}: wire holds {len(data)} bytes but spec "
+        f"{tuple(spec.shape)} {dtype.name} needs {expected}. The "
+        f"record was written against a different shape/dtype.")
+  return np.frombuffer(data, dtype).reshape(spec.shape)
 
 
 def _fit_image(image: np.ndarray, spec: ExtendedTensorSpec) -> np.ndarray:
@@ -149,6 +183,29 @@ def _graph_dtype(tf, spec):
   name = ("bfloat16" if str(spec.dtype) == "bfloat16"
           else np.dtype(spec.dtype).name)
   return getattr(tf, name)
+
+
+def _graph_decode_raw(tf, value, spec, key, allow_empty=False):
+  """decode_raw with the eager parser's byte-length contract in-graph.
+
+  Without the assert, a size-mismatched record would silently fuse
+  examples across the batch dimension (reshape absorbs the extra
+  bytes) or, under fixed_length, be truncated/zero-filled into
+  plausible-looking garbage. `allow_empty` admits the "" time padding
+  of SequenceExample frames (zero-filled via fixed_length).
+  """
+  nbytes = int(np.prod(spec.shape)) * np.dtype(spec.dtype).itemsize
+  lengths = tf.strings.length(value)
+  ok = tf.equal(lengths, nbytes)
+  if allow_empty:
+    ok = tf.logical_or(ok, tf.equal(lengths, 0))
+  with tf.control_dependencies([
+      tf.debugging.Assert(tf.reduce_all(ok), [
+          f"Raw feature {key!r}: wire byte lengths do not match spec "
+          f"{tuple(spec.shape)} {np.dtype(spec.dtype).name} "
+          f"({nbytes} bytes). Lengths seen:", lengths])]):
+    return tf.io.decode_raw(value, _graph_dtype(tf, spec),
+                            fixed_length=nbytes)
 
 
 def _graph_decode_image(tf, encoded, spec):
@@ -193,6 +250,10 @@ def graph_parse_example(serialized, feature_spec) -> Dict[str, Any]:
     if spec.is_image:
       images = _graph_decode_image(tf, value, spec)
       out[key] = tf.cast(images, _graph_dtype(tf, spec))
+      continue
+    if _is_raw(spec):
+      decoded = _graph_decode_raw(tf, value, spec, key)
+      out[key] = tf.reshape(decoded, [-1] + list(spec.shape))
       continue
     if isinstance(value, tf.sparse.SparseTensor):
       value = tf.sparse.to_dense(value)
@@ -252,6 +313,10 @@ def graph_parse_sequence_example(serialized, feature_spec,
         out[key] = tf.cast(
             _graph_decode_image(tf, value, spec),
             _graph_dtype(tf, spec))
+      elif _is_raw(spec):
+        out[key] = tf.reshape(
+            _graph_decode_raw(tf, value, spec, key),
+            [-1] + list(spec.shape))
       elif spec.varlen:
         flat_len = int(np.prod(spec.shape))
         value = tf.reshape(value, [batch, -1])
@@ -287,6 +352,16 @@ def graph_parse_sequence_example(serialized, feature_spec,
           decoded, [-1, sequence_length] + list(spec.shape))
       out[key] = tf.cast(decoded, _graph_dtype(tf, spec))
       continue
+    if _is_raw(spec):
+      # [B, T] byte strings; "" time padding (fit_time pads strings
+      # with "") zero-fills via fixed_length; real frames must match
+      # the spec's byte count exactly (asserted in-graph).
+      frames = tf.reshape(fit_time(value), [-1])
+      decoded = _graph_decode_raw(tf, frames, spec, key,
+                                  allow_empty=True)
+      out[key] = tf.reshape(
+          decoded, [-1, sequence_length] + list(spec.shape))
+      continue
     dense = fit_time(value)  # [B, T, prod(shape)]
     out[key] = tf.cast(
         tf.reshape(dense, [-1, sequence_length] + list(spec.shape)),
@@ -299,6 +374,13 @@ def graph_parse_sequence_example(serialized, feature_spec,
 def _encode_feature(value: Any, spec: ExtendedTensorSpec) -> Any:
   """Encodes ONE unbatched value as a tf.train.Feature per its spec."""
   tf = _tf()
+  if _is_raw(spec):
+    if isinstance(value, (bytes, np.bytes_)):
+      data = bytes(value)
+    else:
+      data = np.ascontiguousarray(
+          np.asarray(value, dtype=np.dtype(spec.dtype))).tobytes()
+    return tf.train.Feature(bytes_list=tf.train.BytesList(value=[data]))
   if spec.is_image:
     if isinstance(value, (bytes, np.bytes_)):
       data = bytes(value)
@@ -372,7 +454,7 @@ def build_sequence_feature_maps(feature_spec: Any):
   sequence_map = {}
   for key, spec in sequence_specs.items():
     name = wire_key(key, spec)
-    if spec.is_image:
+    if spec.is_image or _is_raw(spec):
       sequence_map[name] = tf.io.FixedLenSequenceFeature([], tf.string)
       continue
     dtype = np.dtype(spec.dtype)
@@ -488,6 +570,9 @@ def parse_sequence_example_batch(
         out[key] = np.stack([
             _fit_image(decode_image_bytes(b), spec)
             for b in value.numpy()]).astype(spec.dtype)
+      elif _is_raw(spec):
+        out[key] = np.stack([
+            _fit_raw(b, spec, key) for b in value.numpy()])
       elif spec.varlen:
         out[key] = _pad_or_truncate(np.asarray(value), spec, batch_size)
       else:
@@ -511,6 +596,15 @@ def parse_sequence_example_batch(
         for t in range(min(int(lengths[b]), sequence_length)):
           decoded[b, t] = _fit_image(decode_image_bytes(frames[b, t]),
                                      spec)
+      out[key] = decoded
+      continue
+    if _is_raw(spec):
+      frames = value.numpy()  # [B, T_max] of raw bytes
+      decoded = np.zeros(
+          (batch_size, sequence_length) + tuple(spec.shape), spec.dtype)
+      for b in range(batch_size):
+        for t in range(min(int(lengths[b]), sequence_length)):
+          decoded[b, t] = _fit_raw(frames[b, t], spec, key)
       out[key] = decoded
       continue
     dense = np.asarray(value)  # [B, T_max, prod(shape)]

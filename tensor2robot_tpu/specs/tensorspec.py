@@ -63,9 +63,12 @@ class ExtendedTensorSpec:
       tensors fail validation when missing.
     is_sequence: Marks per-timestep tensors (episode data). Sequence
       tensors get a leading time axis after the batch axis.
-    data_format: For image tensors, the on-disk encoding: 'jpeg', 'png',
-      or None for raw numeric data. Encoded images are stored as strings
-      and decoded host-side before infeed.
+    data_format: The on-disk encoding: 'jpeg'/'png' (image codecs —
+      stored as encoded strings, decoded host-side before infeed),
+      'raw' (one little-endian C-order byte string per tensor — a
+      near-memcpy `decode_raw` at parse time, trading disk for the
+      decode CPU that bounds host feed rate), or None for numeric
+      int64/float lists.
     dataset_key: For multi-dataset input pipelines, the name of the source
       dataset this tensor is read from ('' = default dataset).
     varlen: Variable-length feature (ragged on disk); padded/truncated to

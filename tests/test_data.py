@@ -117,12 +117,80 @@ class TestTFExampleCodec:
     np.testing.assert_array_equal(batch["x"][0], [1, 2, 0, 0])
     np.testing.assert_array_equal(batch["x"][1], [1, 2, 3, 4])
 
+  def test_raw_wire_lossless_roundtrip(self):
+    """data_format='raw': tensors ride as C-order bytes — exact for
+    any dtype, no codec. The decode-CPU escape hatch for hosts that
+    can't jpeg-decode at chip rate."""
+    st = TensorSpecStruct()
+    st.img = ExtendedTensorSpec(shape=(8, 8, 3), dtype=np.uint8,
+                                name="i", data_format="raw")
+    st.depth = ExtendedTensorSpec(shape=(4, 4), dtype=np.float32,
+                                  name="d", data_format="raw")
+    rng = np.random.default_rng(2)
+    img = rng.integers(0, 255, (8, 8, 3), dtype=np.uint8)
+    depth = rng.standard_normal((4, 4)).astype(np.float32)
+    serialized = tfexample.encode_example({"img": img, "depth": depth},
+                                          st)
+    batch = tfexample.parse_example_batch(
+        np.array([serialized, serialized]), st)
+    np.testing.assert_array_equal(batch["img"][1], img)
+    np.testing.assert_array_equal(batch["depth"][0], depth)
+
+  def test_raw_wire_graph_matches_eager(self):
+    import tensorflow as tf
+
+    st = TensorSpecStruct()
+    st.img = ExtendedTensorSpec(shape=(6, 5, 3), dtype=np.uint8,
+                                name="i", data_format="raw")
+    rng = np.random.default_rng(3)
+    img = rng.integers(0, 255, (6, 5, 3), dtype=np.uint8)
+    serialized = tfexample.encode_example({"img": img}, st)
+    eager = tfexample.parse_example_batch(np.array([serialized]), st)
+    graph = tfexample.graph_parse_example(
+        tf.constant([serialized]), st)
+    np.testing.assert_array_equal(np.asarray(graph["img"]),
+                                  eager["img"])
+    np.testing.assert_array_equal(eager["img"][0], img)
+
   def test_sequence_spec_rejected(self):
     st = TensorSpecStruct()
     st.x = ExtendedTensorSpec(shape=(4,), dtype=np.float32, name="x",
                               is_sequence=True)
     with pytest.raises(ValueError, match="add_sequence_length"):
       tfexample.build_feature_map(st)
+
+  @pytest.mark.parametrize("data_format", ["raw", "png"])
+  def test_sequence_spec_rejected_for_bytes_formats(self, data_format):
+    """Raw/image SEQUENCE specs must hit the same SequenceExample
+    error — binding one byte string per example would silently fuse
+    the time axis into the wire blob."""
+    st = TensorSpecStruct()
+    st.x = ExtendedTensorSpec(shape=(4, 4, 3), dtype=np.uint8,
+                              name="x", is_sequence=True,
+                              data_format=data_format)
+    with pytest.raises(ValueError, match="add_sequence_length"):
+      tfexample.build_feature_map(st)
+
+  def test_raw_wire_length_mismatch_raises_eager_and_graph(self):
+    """A record written against a different raw shape must ERROR in
+    both parsers — the graph path would otherwise silently fuse
+    examples across the batch dim (reshape absorbs the bytes)."""
+    import tensorflow as tf
+
+    written = TensorSpecStruct()
+    written.x = ExtendedTensorSpec(shape=(4,), dtype=np.uint8,
+                                   name="x", data_format="raw")
+    declared = TensorSpecStruct()
+    declared.x = ExtendedTensorSpec(shape=(8,), dtype=np.uint8,
+                                    name="x", data_format="raw")
+    serialized = tfexample.encode_example(
+        {"x": np.arange(4, dtype=np.uint8)}, written)
+    with pytest.raises(ValueError, match="wire holds 4 bytes"):
+      tfexample.parse_example_batch(
+          np.array([serialized, serialized]), declared)
+    with pytest.raises(Exception, match="byte lengths"):
+      tfexample.graph_parse_example(
+          tf.constant([serialized, serialized]), declared)
 
   def test_missing_required_feature_raises(self):
     with pytest.raises(ValueError, match="pose"):
@@ -308,6 +376,60 @@ class TestSequenceExampleCodec:
     np.testing.assert_allclose(batch["state"][1], ep_long["state"][:4],
                                rtol=1e-6)
     np.testing.assert_array_equal(batch["task_id"][1], [7])
+
+  def test_raw_sequence_roundtrip_eager_and_graph(self):
+    """Raw frames in episodes: exact round-trip, zero time padding,
+    and graph/eager parity (the graph path zero-fills '' padding via
+    decode_raw's fixed_length)."""
+    import tensorflow as tf
+
+    st = TensorSpecStruct()
+    st.image = ExtendedTensorSpec(shape=(8, 8, 3), dtype=np.uint8,
+                                  name="frame", data_format="raw",
+                                  is_sequence=True)
+    st.goal = ExtendedTensorSpec(shape=(2,), dtype=np.float32,
+                                 name="goal", data_format="raw")
+    rng = np.random.default_rng(5)
+    ep = {
+        "image": rng.integers(0, 255, (3, 8, 8, 3), dtype=np.uint8),
+        "goal": rng.standard_normal(2).astype(np.float32),
+    }
+    serialized = np.array([tfexample.encode_sequence_example(ep, st)])
+    eager = tfexample.parse_sequence_example_batch(
+        serialized, st, sequence_length=4)
+    np.testing.assert_array_equal(eager["image"][0, :3], ep["image"])
+    np.testing.assert_array_equal(eager["image"][0, 3],
+                                  np.zeros((8, 8, 3), np.uint8))
+    np.testing.assert_array_equal(eager["goal"][0], ep["goal"])
+    graph = tfexample.graph_parse_sequence_example(
+        tf.constant(serialized), st, sequence_length=4)
+    np.testing.assert_array_equal(np.asarray(graph["image"]),
+                                  eager["image"])
+    np.testing.assert_array_equal(np.asarray(graph["goal"]),
+                                  eager["goal"])
+
+  def test_raw_sequence_frame_length_mismatch_raises_in_graph(self):
+    """Mismatched raw frames must error in the graph parser too —
+    fixed_length would otherwise zero-fill/truncate them into
+    plausible garbage ('' time padding stays allowed)."""
+    import tensorflow as tf
+
+    written = TensorSpecStruct()
+    written.f = ExtendedTensorSpec(shape=(4,), dtype=np.uint8,
+                                   name="f", data_format="raw",
+                                   is_sequence=True)
+    declared = TensorSpecStruct()
+    declared.f = ExtendedTensorSpec(shape=(8,), dtype=np.uint8,
+                                    name="f", data_format="raw",
+                                    is_sequence=True)
+    serialized = np.array([tfexample.encode_sequence_example(
+        {"f": np.arange(8, dtype=np.uint8).reshape(2, 4)}, written)])
+    with pytest.raises(Exception, match="byte lengths"):
+      np.asarray(tfexample.graph_parse_sequence_example(
+          tf.constant(serialized), declared, sequence_length=3)["f"])
+    with pytest.raises(ValueError, match="wire holds 4 bytes"):
+      tfexample.parse_sequence_example_batch(serialized, declared,
+                                             sequence_length=3)
 
   def test_mismatched_sequence_lengths_rejected(self):
     fs = episode_spec()
