@@ -556,7 +556,17 @@ def _parse_statement(stmt: str, skip_unknown: bool = False) -> None:
       _REGISTRY.bindings.setdefault((scope, canonical), {})[param] = value
 
 
+# Search order for config paths: cwd, the directory of the file being
+# parsed (sibling-relative includes), any user-registered search paths
+# (add_config_file_search_path — these must outrank the built-in
+# fallback so users can shadow shipped configs), and LAST the
+# repo/package root, so the shipped `tensor2robot_tpu/...`
+# repo-relative include paths resolve regardless of the caller's cwd
+# (reference gin configs used the same repo-relative convention).
+_PACKAGE_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 _SEARCH_PATHS: List[str] = [""]
+_INCLUDE_DIR_STACK: List[str] = []
 
 
 def add_config_file_search_path(path: str) -> None:
@@ -564,14 +574,22 @@ def add_config_file_search_path(path: str) -> None:
 
 
 def parse_config_file(path: str, skip_unknown: bool = False) -> None:
-  for base in _SEARCH_PATHS:
+  bases = list(_SEARCH_PATHS) + [_PACKAGE_ROOT]
+  if _INCLUDE_DIR_STACK:
+    bases.insert(1, _INCLUDE_DIR_STACK[-1])
+  for base in bases:
     candidate = os.path.join(base, path) if base else path
     if os.path.exists(candidate):
-      with open(candidate) as f:
-        parse_config(f.read(), skip_unknown=skip_unknown)
+      _INCLUDE_DIR_STACK.append(os.path.dirname(os.path.abspath(
+          candidate)))
+      try:
+        with open(candidate) as f:
+          parse_config(f.read(), skip_unknown=skip_unknown)
+      finally:
+        _INCLUDE_DIR_STACK.pop()
       return
   raise GinError(f"Config file not found: {path!r} "
-                 f"(search paths: {_SEARCH_PATHS})")
+                 f"(search paths: {bases})")
 
 
 def parse_config_files_and_bindings(

@@ -72,7 +72,8 @@ class AbstractT2RModel(ModelInterface):
                preprocessor_cls: Optional[Callable] = None,
                create_optimizer_fn: Callable = opt_lib.create_optimizer,
                init_from_checkpoint_path: Optional[str] = None,
-               device_dtype=jnp.float32):
+               device_dtype=jnp.float32,
+               aux_loss_weight: float = 0.01):
     """Args:
       preprocessor_cls: class (or factory) called with the two model spec
         getter fns; defaults to NoOpPreprocessor.
@@ -82,11 +83,15 @@ class AbstractT2RModel(ModelInterface):
         present in the checkpoint override fresh initializers
         (reference: maybe_init_from_checkpoint).
       device_dtype: compute dtype networks should favor (bfloat16 on TPU).
+      aux_loss_weight: weight on auxiliary losses the network sows into
+        the "aux_loss" collection (e.g. the MoE load-balance loss);
+        irrelevant for networks that sow none.
     """
     self._preprocessor_cls = preprocessor_cls
     self._create_optimizer_fn = create_optimizer_fn
     self._init_from_checkpoint_path = init_from_checkpoint_path
     self._device_dtype = device_dtype
+    self._aux_loss_weight = aux_loss_weight
     self._preprocessor = None
     self._network = None
     self._tx = None
@@ -132,23 +137,43 @@ class AbstractT2RModel(ModelInterface):
       self._tx = self._create_optimizer_fn()
     return self._tx
 
+  AUX_LOSS_OUTPUT = "_aux_loss"
+
   def inference_network_fn(self,
                            variables: Dict[str, Any],
                            features: TensorSpecStruct,
                            mode: Mode,
                            rng: Optional[jax.Array] = None) -> Any:
-    """Applies the network; returns (outputs, new_batch_stats)."""
+    """Applies the network; returns (outputs, new_batch_stats).
+
+    Auxiliary losses the network sows into the "aux_loss" collection
+    (MoE load balance) are summed into `outputs[AUX_LOSS_OUTPUT]` for
+    `loss_fn` to weight in; `predict_step` strips the key so serving
+    signatures never see it.
+    """
     train = mode == Mode.TRAIN
     rngs = {"dropout": rng} if (train and rng is not None) else None
     has_stats = "batch_stats" in variables
+    mutable = ["aux_loss"]
     if train and has_stats:
-      outputs, updates = self.network.apply(
-          variables, features, train=True, rngs=rngs,
-          mutable=["batch_stats"])
-      return outputs, updates.get("batch_stats", {})
-    outputs = self.network.apply(variables, features, train=train,
-                                 rngs=rngs)
-    return outputs, variables.get("batch_stats", {})
+      mutable.append("batch_stats")
+    outputs, updates = self.network.apply(
+        variables, features, train=train, rngs=rngs, mutable=mutable)
+    if updates.get("aux_loss"):
+      if not isinstance(outputs, dict):
+        # Silently dropping a sown regularizer would let experts
+        # collapse with no signal; the contract is explicit instead.
+        raise TypeError(
+            f"{type(self.network).__name__} sowed 'aux_loss' "
+            f"variables but returned {type(outputs).__name__} "
+            f"outputs; networks with auxiliary losses must return a "
+            f"dict so the loss can be threaded through "
+            f"(outputs[{self.AUX_LOSS_OUTPUT!r}]).")
+      from tensor2robot_tpu.parallel.moe import collect_aux_losses
+      outputs[self.AUX_LOSS_OUTPUT] = collect_aux_losses(updates)
+    new_stats = (updates.get("batch_stats", {}) if train and has_stats
+                 else variables.get("batch_stats", {}))
+    return outputs, new_stats
 
   # ---- losses/metrics ----
 
@@ -267,6 +292,10 @@ class AbstractT2RModel(ModelInterface):
     outputs, new_stats = self.inference_network_fn(
         variables, features, mode, rng_net)
     loss, scalars = self.model_train_fn(features, labels, outputs, mode)
+    if isinstance(outputs, dict) and self.AUX_LOSS_OUTPUT in outputs:
+      aux = outputs[self.AUX_LOSS_OUTPUT]
+      loss = loss + self._aux_loss_weight * aux
+      scalars = {**scalars, "aux_loss": aux}
     return loss, (scalars, new_stats)
 
   def train_step(self, state: TrainState, features, labels,
@@ -304,4 +333,6 @@ class AbstractT2RModel(ModelInterface):
         features, None, Mode.PREDICT, None)
     outputs, _ = self.inference_network_fn(variables, features,
                                            Mode.PREDICT)
+    if isinstance(outputs, dict):
+      outputs.pop(self.AUX_LOSS_OUTPUT, None)
     return outputs

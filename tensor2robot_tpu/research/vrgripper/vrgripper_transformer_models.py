@@ -54,6 +54,8 @@ class _EpisodeTransformerNet(nn.Module):
   attention_impl: str
   mesh: Optional[Any] = None
   dtype: Any = jnp.bfloat16
+  moe_experts: int = 0
+  moe_every: int = 2
 
   @nn.compact
   def __call__(self, features, train: bool = False):
@@ -77,6 +79,7 @@ class _EpisodeTransformerNet(nn.Module):
         width=self.width, depth=self.depth, num_heads=self.num_heads,
         max_len=self.max_len, attention_impl=self.attention_impl,
         causal=True, mesh=self.mesh, dtype=self.dtype,
+        moe_experts=self.moe_experts, moe_every=self.moe_every,
         name="trunk")(emb, train=train)
     action = nn.Dense(self.action_dim, dtype=self.dtype,
                       name="action_head")(
@@ -100,11 +103,17 @@ class VRGripperTransformerModel(AbstractT2RModel):
                max_context_length: int = 512,
                attention_impl: str = "auto",
                mesh: Optional[Any] = None,
+               moe_experts: int = 0,
+               moe_every: int = 2,
                device_dtype=jnp.bfloat16,
                **kwargs):
     """`mesh`: required for attention_impl="ring"/"ring_flash" — the
     device mesh whose `seq` axis the episode dimension shards over
-    (sequence parallelism); unused by single-device backends."""
+    (sequence parallelism); unused by single-device backends.
+    `moe_experts`/`moe_every`: swap every `moe_every`-th block's MLP
+    for that many routed experts (`parallel/moe.py`); with a mesh
+    `expert` axis they run expert-parallel, and the load-balance aux
+    loss joins training via the base model's aux_loss_weight."""
     super().__init__(device_dtype=device_dtype, **kwargs)
     self._image_size = image_size
     self._state_dim = state_dim
@@ -117,6 +126,8 @@ class VRGripperTransformerModel(AbstractT2RModel):
     self._max_len = max_context_length
     self._attention_impl = attention_impl
     self._mesh = mesh
+    self._moe_experts = moe_experts
+    self._moe_every = moe_every
     if mesh is not None:
       from tensor2robot_tpu.parallel.mesh import SEQ_AXIS
       if (SEQ_AXIS in mesh.axis_names
@@ -169,6 +180,8 @@ class VRGripperTransformerModel(AbstractT2RModel):
         max_len=self._max_len,
         attention_impl=self._attention_impl,
         mesh=self._mesh,
+        moe_experts=self._moe_experts,
+        moe_every=self._moe_every,
         dtype=self.device_dtype,
     )
 

@@ -380,6 +380,55 @@ class TestTransformerBC:
     np.testing.assert_allclose(float(loss1), float(loss2), rtol=1e-6)
 
 
+class TestMoETransformerBC:
+  """MoE through the research family: trains, aux loss in the loop."""
+
+  def test_train_steps_include_aux_loss_and_predict_strips_it(self):
+    model = tiny_model(moe_experts=2, moe_every=1)
+    state = model.create_train_state(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(11)
+    t = 8
+    feats = TensorSpecStruct.from_flat_dict({
+        "image": rng.integers(0, 255, (2, t, IMG, IMG, 3)
+                              ).astype(np.uint8),
+        "gripper_pose": rng.standard_normal((2, t, 3)
+                                            ).astype(np.float32),
+    })
+    labels = TensorSpecStruct.from_flat_dict({
+        "action": rng.standard_normal((2, t, 3)).astype(np.float32)})
+    step = jax.jit(model.train_step)
+    for i in range(3):
+      state, metrics = step(state, feats, labels,
+                            jax.random.PRNGKey(i))
+    # The load-balance aux is a training metric and part of the loss.
+    assert "aux_loss" in metrics
+    assert float(metrics["aux_loss"]) >= 1.0 - 1e-4
+    assert np.isfinite(float(metrics["loss"]))
+    # Serving outputs never carry the private aux key.
+    out = model.predict_step(state, feats)
+    assert "_aux_loss" not in out
+    assert out["action"].shape == (2, t, 3)
+
+  def test_moe_gin_config_parses(self):
+    from tensor2robot_tpu import config as gin
+    import tensor2robot_tpu.train_eval  # noqa: F401
+    import tensor2robot_tpu.research.vrgripper  # noqa: F401
+    import tensor2robot_tpu.data  # noqa: F401
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tensor2robot_tpu", "research", "vrgripper", "configs",
+        "train_vrgripper_transformer_moe.gin")
+    gin.clear_config()
+    try:
+      gin.parse_config_files_and_bindings([path], [])
+      model = gin.query_parameter("train_eval_model.model").resolve()
+      assert model._moe_experts == 8
+      net = model.create_network()
+      assert net.moe_experts == 8
+    finally:
+      gin.clear_config()
+
+
 class TestShippedConfig:
 
   def test_config_parses_and_builds_model(self):
