@@ -536,6 +536,9 @@ def main():
     detail["replay_pipeline"] = bench_replay_pipeline(steps)
   if "--longcontext" in args:
     detail["long_context"] = bench_long_context()
+    # Same FLOPs, MXU-filling head width: the empirical half of the
+    # kernel's D=64 roofline argument (128-lane contraction).
+    detail["long_context_d128"] = bench_long_context(heads=2, d=128)
   if "--podscale" in args:
     detail["pod_scaling"] = bench_pod_scaling()
 

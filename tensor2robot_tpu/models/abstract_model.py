@@ -291,9 +291,12 @@ class AbstractT2RModel(ModelInterface):
     features = self.network_inputs_from_labels(features, labels, mode)
     outputs, new_stats = self.inference_network_fn(
         variables, features, mode, rng_net)
+    # Pop BEFORE model_train_fn: subclass losses/metrics never see the
+    # private key (predict_step shields its consumers the same way).
+    aux = (outputs.pop(self.AUX_LOSS_OUTPUT, None)
+           if isinstance(outputs, dict) else None)
     loss, scalars = self.model_train_fn(features, labels, outputs, mode)
-    if isinstance(outputs, dict) and self.AUX_LOSS_OUTPUT in outputs:
-      aux = outputs[self.AUX_LOSS_OUTPUT]
+    if aux is not None:
       loss = loss + self._aux_loss_weight * aux
       scalars = {**scalars, "aux_loss": aux}
     return loss, (scalars, new_stats)
@@ -325,7 +328,15 @@ class AbstractT2RModel(ModelInterface):
     features = self.network_inputs_from_labels(features, labels,
                                                Mode.EVAL)
     outputs, _ = self.inference_network_fn(variables, features, Mode.EVAL)
-    return self.model_eval_fn(features, labels, outputs)
+    # Same aux treatment as loss_fn, so the eval "loss" tracks the
+    # optimized objective and expert collapse is visible in eval too.
+    aux = (outputs.pop(self.AUX_LOSS_OUTPUT, None)
+           if isinstance(outputs, dict) else None)
+    metrics = self.model_eval_fn(features, labels, outputs)
+    if aux is not None:
+      metrics = {**metrics, "aux_loss": aux,
+                 "loss": metrics["loss"] + self._aux_loss_weight * aux}
+    return metrics
 
   def predict_step(self, state: TrainState, features) -> Any:
     variables = state.variables

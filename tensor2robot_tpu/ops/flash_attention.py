@@ -22,6 +22,12 @@ pl.when, and the lse is stored as (8, block_q) tiles instead of a
 peak is structural at D=64: the score/PV matmuls contract only 64
 lanes of the 128-wide MXU, and the online-softmax VPU work (exp,
 max, rescale) is comparable to the matmul time at these tile shapes.
+That argument is confirmed empirically: the SAME kernel at D=128
+(H halved, identical FLOPs) is consistently faster — 1.25× in the
+committed run (36.1 vs 28.9 TFLOP/s, `BENCH_DETAIL.json` →
+`long_context_d128` vs `long_context`), 1.8× in a quieter-tunnel
+session (43 vs 24). Models that care about attention throughput at
+long context should prefer MXU-width heads.
 
 Training works end to end: a custom VJP recomputes per-block scores
 from the saved logsumexp (the standard flash backward), scanned over
