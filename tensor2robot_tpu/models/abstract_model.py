@@ -334,8 +334,12 @@ class AbstractT2RModel(ModelInterface):
            if isinstance(outputs, dict) else None)
     metrics = self.model_eval_fn(features, labels, outputs)
     if aux is not None:
-      metrics = {**metrics, "aux_loss": aux,
-                 "loss": metrics["loss"] + self._aux_loss_weight * aux}
+      metrics = {**metrics, "aux_loss": aux}
+      # model_eval_fn's contract promises only "scalars" — a custom
+      # override may not report a "loss" key at all.
+      if "loss" in metrics:
+        metrics["loss"] = (metrics["loss"]
+                           + self._aux_loss_weight * aux)
     return metrics
 
   def predict_step(self, state: TrainState, features) -> Any:

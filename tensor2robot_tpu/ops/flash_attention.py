@@ -10,8 +10,9 @@ block_k) tiles, scores live only in registers/VMEM, and the online
 softmax carries running max/normalizer/accumulator in f32 scratch.
 
 Measured on v5e at T=32768 causal (scan-amortized, D2H-barriered):
-24 TFLOP/s ≈ 12% of bf16 peak — where the materialized XLA attention
-OOMs beyond T≈4096. (Round 3 recorded 147 TFLOP/s for this kernel;
+28.9 TFLOP/s ≈ 15% of bf16 peak at D=64 in the committed run
+(session spread 24–29; see below for D=128) — where the materialized
+XLA attention OOMs beyond T≈4096. (Round 3 recorded 147 TFLOP/s for this kernel;
 that number does not reproduce under the hardened timing methodology
 and is retracted — see bench.py's docstring for why early numbers
 were tunnel artifacts.) The round-4 kernel is ~7× the honest round-3
