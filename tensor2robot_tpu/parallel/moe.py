@@ -28,6 +28,14 @@ the standard static-shape GShard/Switch formulation, built TPU-first:
 `moe_mlp` is the functional core (used under shard_map and as the
 single-device reference); `MoEMLP` is the flax module that owns the
 params and sows the load-balance auxiliary loss.
+
+Composition note: EP groups tokens over the data (+expert) axes. In a
+mesh that ALSO has a non-trivial `seq` axis (ring attention), the MoE
+layer still computes correctly, but GSPMD must reshard activations
+from sequence-sharded to token-group-sharded and back around every
+MoE layer — an extra all-to-all-ish cost the collective audit does
+not pin. Long-context MoE layouts should put MoE cadence low
+(`moe_every` high) or keep `expert` and `seq` on separate meshes.
 """
 
 from __future__ import annotations
