@@ -154,28 +154,49 @@ class TestCausalTransformer:
           err_msg=str(path))
 
 
+def _train_bc_run(tmp_path_factory, name, demo_seed, **model_kwargs):
+  """Shared BC train harness: demos → train_eval → (model, model_dir).
+
+  One copy of the harness config so the dense and MoE families cannot
+  silently diverge."""
+  root = tmp_path_factory.mktemp(name)
+  data = collect_demo_episodes(
+      str(root / "demos.tfrecord"), num_episodes=96, image_size=IMG,
+      seed=demo_seed, action_noise=0.1)
+  model = tiny_model(**model_kwargs)
+  model_dir = str(root / "model")
+  train_eval.train_eval_model(
+      model=model,
+      model_dir=model_dir,
+      input_generator_train=TFRecordEpisodeInputGenerator(
+          file_patterns=data, sequence_length=16, batch_size=16,
+          shuffle_buffer_size=96, seed=1),
+      max_train_steps=400,
+      batch_size=8,
+      save_checkpoints_steps=400,
+      log_every_steps=10,
+  )
+  return model, model_dir
+
+
+def _restored_context_policy(model, model_dir, context_length=16):
+  """Restore-from-checkpoint → full-history policy, one copy."""
+  from tensor2robot_tpu.utils import checkpoints as ckpt_lib
+
+  state = model.create_inference_state(jax.random.PRNGKey(0))
+  variables = ckpt_lib.restore_variables(
+      model_dir, like={"params": state.params,
+                       "batch_stats": state.batch_stats or {}})
+  state = state.replace(params=variables["params"])
+  return model.make_context_policy(state,
+                                   context_length=context_length)
+
+
 class TestTransformerBC:
 
   @pytest.fixture(scope="class")
   def run(self, tmp_path_factory):
-    root = tmp_path_factory.mktemp("tf_bc")
-    data = collect_demo_episodes(
-        str(root / "demos.tfrecord"), num_episodes=96, image_size=IMG,
-        seed=0, action_noise=0.1)
-    model = tiny_model()
-    model_dir = str(root / "model")
-    train_eval.train_eval_model(
-        model=model,
-        model_dir=model_dir,
-        input_generator_train=TFRecordEpisodeInputGenerator(
-            file_patterns=data, sequence_length=16, batch_size=16,
-            shuffle_buffer_size=96, seed=1),
-        max_train_steps=400,
-        batch_size=8,
-        save_checkpoints_steps=400,
-        log_every_steps=10,
-    )
-    return model, model_dir
+    return _train_bc_run(tmp_path_factory, "tf_bc", demo_seed=0)
 
   def test_loss_decreases(self, run):
     _, model_dir = run
@@ -221,15 +242,9 @@ class TestTransformerBC:
     from tensor2robot_tpu.research.vrgripper import (
         evaluate_gripper_policy,
     )
-    from tensor2robot_tpu.utils import checkpoints as ckpt_lib
 
     model, model_dir = run
-    state = model.create_inference_state(jax.random.PRNGKey(0))
-    variables = ckpt_lib.restore_variables(
-        model_dir, like={"params": state.params,
-                         "batch_stats": state.batch_stats or {}})
-    state = state.replace(params=variables["params"])
-    policy = model.make_context_policy(state, context_length=16)
+    policy = _restored_context_policy(model, model_dir)
     metrics = evaluate_gripper_policy(
         policy, num_episodes=10, image_size=IMG, seed=33)
     assert metrics["num_episodes"] == 10.0
@@ -382,6 +397,30 @@ class TestTransformerBC:
 
 class TestMoETransformerBC:
   """MoE through the research family: trains, aux loss in the loop."""
+
+  @pytest.fixture(scope="class")
+  def run_moe(self, tmp_path_factory):
+    """Train the MoE variant through the SAME harness as the dense
+    family (one config, two model kwargs)."""
+    return _train_bc_run(tmp_path_factory, "tf_moe_bc", demo_seed=5,
+                         moe_experts=2, moe_every=1)
+
+  def test_moe_clone_closes_the_loop(self, run_moe):
+    """Routed-expert BC must actually learn the task, not just run:
+    same closed-loop success bar as the dense transformer family."""
+    from tensor2robot_tpu.research.vrgripper import (
+        evaluate_gripper_policy,
+    )
+
+    model, model_dir = run_moe
+    records = [json.loads(line) for line in
+               open(os.path.join(model_dir, "metrics_train.jsonl"))]
+    assert records[-1]["mse"] < records[0]["mse"] * 0.7
+    assert "aux_loss" in records[-1]  # experts routed during training
+    policy = _restored_context_policy(model, model_dir)
+    metrics = evaluate_gripper_policy(
+        policy, num_episodes=10, image_size=IMG, seed=37)
+    assert metrics["success_rate"] >= 0.4, metrics
 
   def test_train_steps_include_aux_loss_and_predict_strips_it(self):
     model = tiny_model(moe_experts=2, moe_every=1)
