@@ -27,6 +27,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tensor2robot_tpu import config as gin
+
 DATA_AXIS = "data"
 FSDP_AXIS = "fsdp"
 MODEL_AXIS = "model"
@@ -35,6 +37,7 @@ EXPERT_AXIS = "expert"
 STAGE_AXIS = "stage"
 
 
+@gin.configurable
 def create_mesh(
     axis_shapes: Optional[Dict[str, int]] = None,
     devices: Optional[Sequence[jax.Device]] = None,

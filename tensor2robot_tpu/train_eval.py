@@ -37,6 +37,7 @@ from tensor2robot_tpu.data import prefetch as prefetch_lib
 from tensor2robot_tpu.hooks import Hook, HookList
 from tensor2robot_tpu.models.model_interface import ModelInterface
 from tensor2robot_tpu.parallel import mesh as mesh_lib
+from tensor2robot_tpu.parallel import state_sharding
 from tensor2robot_tpu.utils import checkpoints as ckpt_lib
 
 log = logging.getLogger(__name__)
@@ -72,19 +73,27 @@ class MetricLogger:
     self._files.clear()
 
 
-def _compile_steps(model: ModelInterface, mesh, donate: bool = True):
-  """Jits train/eval steps with mesh shardings (batch on data axis)."""
+def _compile_steps(model: ModelInterface, mesh, donate: bool = True,
+                   state_shardings=None):
+  """Jits train/eval steps with mesh shardings (batch on data axis).
+
+  `state_shardings`: a NamedSharding pytree for the TrainState (from
+  `parallel.state_sharding`); None replicates the state — pure data
+  parallelism, the reference-equivalent default.
+  """
   repl = mesh_lib.replicated(mesh)
+  if state_shardings is None:
+    state_shardings = repl
   batch = mesh_lib.batch_sharding(mesh)
   train_step = jax.jit(
       model.train_step,
-      in_shardings=(repl, batch, batch, repl),
-      out_shardings=(repl, repl),
+      in_shardings=(state_shardings, batch, batch, repl),
+      out_shardings=(state_shardings, repl),
       donate_argnums=(0,) if donate else (),
   )
   eval_step = jax.jit(
       model.eval_step,
-      in_shardings=(repl, batch, batch),
+      in_shardings=(state_shardings, batch, batch),
       out_shardings=repl,
   )
   return train_step, eval_step
@@ -128,6 +137,8 @@ def train_eval_model(
     batch_size: Optional[int] = None,
     eval_batch_size: Optional[int] = None,
     mesh: Optional[jax.sharding.Mesh] = None,
+    sharding_strategy: str = "replicated",
+    min_size_to_shard: int = 2 ** 10,
     create_exporters_fn: Optional[Callable] = None,
     hooks: Iterable[Hook] = (),
     log_every_steps: int = 100,
@@ -136,7 +147,15 @@ def train_eval_model(
 ):
   """Trains (with interleaved eval) and exports; resumes automatically.
 
-  Returns the final TrainState (on device, replicated over the mesh).
+  `sharding_strategy` selects the TrainState placement over the mesh
+  (`parallel.state_sharding` rules): "replicated" (pure data
+  parallelism, the default), "fsdp" (zero-style param/optimizer
+  sharding over the `fsdp` axis), "tp" (megatron-style over `model`),
+  or "ep" (stacked expert weights over `expert` — MoE models). The
+  batch always shards over the data-like axes; GSPMD inserts the
+  collectives each layout needs.
+
+  Returns the final TrainState (on device, placed per the strategy).
   """
   if mesh is None:
     mesh = mesh_lib.create_mesh()
@@ -153,17 +172,23 @@ def train_eval_model(
   # --- init / resume state ---
   rng = jax.random.PRNGKey(seed)
   state = model.create_train_state(rng, batch_size=init_batch_size)
-  state = jax.device_put(state, mesh_lib.replicated(mesh))
+  state_shardings = state_sharding(
+      mesh, state, strategy=sharding_strategy,
+      min_size_to_shard=min_size_to_shard)
+  state = jax.device_put(state, state_shardings)
   resume_step = ckpt_lib.latest_step(model_dir)
   if resume_step is not None:
     log.info("Resuming from checkpoint at step %d in %s", resume_step,
              model_dir)
+    # Restored leaves adopt `state`'s shardings — checkpoints are
+    # portable across strategies/layouts (tests/test_checkpoint_resharding).
     state = ckpt_lib.restore_state(model_dir, like=state,
                                    step=resume_step)
 
   writer = ckpt_lib.CheckpointWriter(
       model_dir, max_to_keep=max_checkpoints_to_keep)
-  train_step, eval_step = _compile_steps(model, mesh)
+  train_step, eval_step = _compile_steps(
+      model, mesh, state_shardings=state_shardings)
   hook_list.begin(model, model_dir)
 
   step = int(np.asarray(jax.device_get(state.step)))
@@ -199,7 +224,13 @@ def train_eval_model(
           steps_since_log = 0
 
         if step % save_checkpoints_steps == 0 or step == max_train_steps:
-          writer.save(step, jax.device_get(state))
+          # Sharded state saves AS-IS: orbax copies device shards to
+          # host before save() returns (so the next step's donation
+          # is safe), serializes asynchronously, and each process
+          # writes only its addressable shards — a host-side
+          # device_get here would block, materialize the unsharded
+          # state, and crash on a multi-process pod.
+          writer.save(step, state)
           last_saved_step = step
           hook_list.after_checkpoint(step, state, model_dir)
 
@@ -214,7 +245,7 @@ def train_eval_model(
 
       # Final checkpoint if the loop ended off-interval.
       if last_saved_step != step:
-        writer.save(step, jax.device_get(state))
+        writer.save(step, state)
         hook_list.after_checkpoint(step, state, model_dir)
 
     # --- final eval ---
