@@ -62,7 +62,8 @@ def stage_sharding(mesh: Mesh, tree: Any) -> Any:
   return jax.tree_util.tree_map(rule, tree)
 
 
-def _pipeline_local(params, x, *, apply_fn, num_stages, axis_name):
+def _pipeline_local(params, x, *, apply_fn, num_stages, axis_name,
+                    remat):
   """Per-device body: my stage's params (leading dim 1), all microbatches.
 
   x: [M, mb_local, ...]; returns [M, mb_local, ...] — valid on every
@@ -73,6 +74,11 @@ def _pipeline_local(params, x, *, apply_fn, num_stages, axis_name):
   idx = jax.lax.axis_index(axis_name)
   num_micro = x.shape[0]
   perm = [(j, (j + 1) % num_stages) for j in range(num_stages)]
+  if remat:
+    # GPipe's standard memory trade: store only stage boundaries,
+    # recompute within-stage activations in the backward.
+    # prevent_cse=False is documented safe (and faster) under scan.
+    apply_fn = jax.checkpoint(apply_fn, prevent_cse=False)
 
   def tick(carry, t):
     state, out = carry
@@ -110,6 +116,7 @@ def pipeline_apply(
     mesh: Optional[Mesh],
     num_microbatches: int,
     axis_name: str = STAGE_AXIS,
+    remat: bool = False,
 ) -> jax.Array:
   """Runs x through S pipelined stages of `apply_fn`.
 
@@ -123,6 +130,10 @@ def pipeline_apply(
       shards over `data`, microbatching happens on the per-shard rows).
     mesh: mesh with `axis_name`; its size S is the stage count.
     num_microbatches: M; the pipeline bubble is (S-1)/(M+S-1).
+    remat: rematerialize within-stage activations in the backward
+      (`jax.checkpoint` around each stage application) — activation
+      memory drops from per-layer to per-stage-boundary at ~1/3 more
+      FLOPs, the standard GPipe configuration for deep stages.
 
   Returns [B, ...] with the same sharding layout as x.
 
@@ -131,8 +142,10 @@ def pipeline_apply(
   """
   if (mesh is None or axis_name not in mesh.axis_names
       or mesh.shape[axis_name] == 1):
+    fn = (jax.checkpoint(apply_fn, prevent_cse=False) if remat
+          else apply_fn)
     def body(h, p):
-      return apply_fn(p, h), ()
+      return fn(p, h), ()
     out, _ = jax.lax.scan(body, x, stage_params)
     return out
 
@@ -151,7 +164,7 @@ def pipeline_apply(
 
   body = functools.partial(
       _pipeline_local, apply_fn=apply_fn, num_stages=num_stages,
-      axis_name=axis_name)
+      axis_name=axis_name, remat=remat)
   data_axis = DATA_AXIS if DATA_AXIS in mesh.axis_names else None
   xspec = P(None, data_axis)
   out = jax.shard_map(

@@ -115,18 +115,20 @@ class TestExpertParallel:
   def mesh(self, request):
     return create_mesh(request.param)
 
-  def _build(self, mesh, dtype=jnp.float32):
-    module = MoEMLP(num_experts=8, hidden_dim=16, k=2,
+  def _build(self, mesh, dtype=jnp.float32, k=2):
+    module = MoEMLP(num_experts=8, hidden_dim=16, k=k,
                     capacity_factor=4.0, mesh=mesh, dtype=dtype)
     x = jnp.asarray(
         np.random.default_rng(2).standard_normal((4, 16, 8)), dtype)
-    ref = MoEMLP(num_experts=8, hidden_dim=16, k=2,
+    ref = MoEMLP(num_experts=8, hidden_dim=16, k=k,
                  capacity_factor=4.0, mesh=None, dtype=dtype)
     variables = ref.init(jax.random.PRNGKey(0), x)
     return module, ref, variables, x
 
-  def test_forward_matches_dense(self, mesh):
-    module, ref, variables, x = self._build(mesh)
+  @pytest.mark.parametrize("k", [1, 2])
+  def test_forward_matches_dense(self, mesh, k):
+    """k=1 is Switch routing, k=2 GShard — both exact under EP."""
+    module, ref, variables, x = self._build(mesh, k=k)
     out_ref, _ = ref.apply(variables, x, mutable=["aux_loss"])
     out_ep, state = jax.jit(
         lambda v, x: module.apply(v, x, mutable=["aux_loss"])
