@@ -45,6 +45,8 @@ Usage: python bench.py [--paper] [--profile DIR] [--input] [--replay]
              QT-Opt loop actually uses.
   --longcontext  flash-attention forward + train rates at T=32k
              causal (the long-context serving/training numbers).
+  --moe      MoE-transformer train rate vs its dense twin on one
+             chip (isolates the routing-machinery overhead).
   --podscale measure per-chip step rate at pod-local batch sizes
              (weak vs strong scaling anchors for the 10k target).
 """
@@ -373,6 +375,71 @@ def bench_pod_scaling(scan: int = 200):
   }
 
 
+def bench_moe(batch: int = 8, t: int = 256, width: int = 256,
+              depth: int = 4, experts: int = 8, scan: int = 20):
+  """Train-rate cost of enabling MoE on the trunk, on one chip.
+
+  Same trunk, every other MLP swapped for `experts` routed experts
+  (top-2, cf=2, each expert the full dense-MLP size). The slowdown is
+  NOT pure routing overhead: top-2 full-size experts run ~2x the
+  dense MLP's active FLOPs, expert matmuls cover all k*cf*N slot rows
+  (occupied or not), and the one-hot dispatch/combine einsums cost
+  O(N*E*C) on top. What this pins is the practical question — what a
+  user pays in steps/s to turn `moe_experts=8` on at this scale —
+  with the capacity question (more params at constant active depth)
+  bought for that price. Expert PARALLELISM isn't measurable on one
+  chip; this is the single-chip formulation cost the EP design then
+  spreads.
+  """
+  from tensor2robot_tpu.layers.transformer import CausalTransformer
+  from tensor2robot_tpu.parallel.moe import collect_aux_losses
+
+  rng = np.random.default_rng(0)
+  x = jnp.asarray(rng.standard_normal((batch, t, width)),
+                  jnp.bfloat16)
+
+  def steps_per_sec(moe_experts):
+    model = CausalTransformer(
+        width=width, depth=depth, num_heads=width // 64, max_len=t,
+        attention_impl="flash", moe_experts=moe_experts, moe_every=2)
+    params = model.init(jax.random.PRNGKey(0), x)["params"]
+
+    def loss(p, x):
+      out, state = model.apply({"params": p}, x,
+                               mutable=["aux_loss"])
+      return (jnp.mean(out ** 2)
+              + 0.01 * collect_aux_losses(state))
+
+    @jax.jit
+    def many(p, x):
+      def body(p, _):
+        g = jax.grad(loss)(p, x)
+        return jax.tree_util.tree_map(
+            lambda w, gg: w - 1e-4 * gg.astype(w.dtype), p, g), ()
+      p, _ = jax.lax.scan(body, p, jnp.arange(scan))
+      return jax.tree_util.tree_leaves(p)[0].sum()
+
+    float(many(params, x))  # compile + warm
+    best = np.inf
+    for _ in range(3):
+      t0 = time.perf_counter()
+      float(many(params, x))  # D2H barrier
+      best = min(best, time.perf_counter() - t0)
+    return scan / best
+
+  dense = steps_per_sec(0)
+  moe = steps_per_sec(experts)
+  return {
+      "config": (f"transformer B={batch} T={t} W={width} D={depth}, "
+                 f"MoE E={experts} top-2 cf=2 every-2 (full-size "
+                 f"experts: ~2x active MLP FLOPs + dispatch) vs the "
+                 f"dense trunk, bf16, train step, scan-amortized"),
+      "dense_steps_per_sec": round(dense, 2),
+      "moe_steps_per_sec": round(moe, 2),
+      "moe_slowdown_pct": round((dense / moe - 1) * 100, 1),
+  }
+
+
 def bench_long_context(t: int = 32768, heads: int = 4, d: int = 64,
                        scan: int = 10):
   """Flash-attention forward and train (fwd+bwd) rates at long T.
@@ -541,6 +608,8 @@ def main():
     detail["long_context_d128"] = bench_long_context(heads=2, d=128)
   if "--podscale" in args:
     detail["pod_scaling"] = bench_pod_scaling()
+  if "--moe" in args:
+    detail["moe_overhead"] = bench_moe()
 
   with open("BENCH_DETAIL.json", "w") as f:
     json.dump(detail, f, indent=2)
