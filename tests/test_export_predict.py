@@ -99,6 +99,70 @@ class TestSavedModelExport:
       predictor.predict({})
 
 
+class TestRawWireServing:
+  """data_format='raw' specs ride the exported tf.Example signature:
+  the same graph parser serves serialized protos with near-memcpy
+  decode (no image codec robot-side)."""
+
+  def test_raw_spec_proto_signature_round_trip(self, tmp_path):
+    import tensorflow as tf
+
+    from tensor2robot_tpu.data import tfexample
+    from tensor2robot_tpu.specs import (
+        ExtendedTensorSpec,
+        TensorSpecStruct,
+    )
+
+    class RawImageModel(MockT2RModel):
+
+      def get_feature_specification(self, mode):
+        st = TensorSpecStruct()
+        st.x = ExtendedTensorSpec(shape=(4, 4, 3), dtype=np.uint8,
+                                  name="x", data_format="raw")
+        return st
+
+      def create_network(self):
+        import flax.linen as nn
+        import jax.numpy as jnp
+
+        class Net(nn.Module):
+
+          @nn.compact
+          def __call__(self, features, train=False):
+            flat = features.to_flat_dict() \
+                if hasattr(features, "to_flat_dict") else features
+            x = flat["x"].astype(jnp.float32).reshape(
+                (flat["x"].shape[0], -1)) / 255.0
+            out = nn.Dense(2)(x)
+            return {"output": out}
+
+        return Net()
+
+    model = RawImageModel()
+    state = model.create_inference_state(jax.random.PRNGKey(0))
+    model_dir = str(tmp_path)
+    export_dir = SavedModelExportGenerator().export(
+        model, jax.device_get(state), model_dir)
+    loaded = tf.saved_model.load(export_dir)
+    # Raw specs are NOT sequences, so the proto signature builds.
+    assert "parse_tf_example" in loaded.signatures
+
+    rng = np.random.default_rng(3)
+    images = rng.integers(0, 255, (2, 4, 4, 3)).astype(np.uint8)
+    serialized = [
+        tfexample.encode_example(
+            {"x": img}, model.get_feature_specification(Mode.PREDICT))
+        for img in images
+    ]
+    from_protos = loaded.signatures["parse_tf_example"](
+        examples=tf.constant(serialized))
+    direct = loaded.signatures["serving_default"](
+        x=tf.constant(images))
+    np.testing.assert_allclose(
+        np.asarray(from_protos["output"]),
+        np.asarray(direct["output"]), atol=1e-5)
+
+
 class TestCheckpointPredictor:
 
   def test_restore_and_predict(self, trained):

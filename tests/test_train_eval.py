@@ -195,6 +195,46 @@ def test_fsdp_strategy_trains_and_resumes(tmp_path):
   assert int(np.asarray(jax.device_get(state.step))) == 8
 
 
+def test_fsdp_trained_model_exports_and_serves(tmp_path):
+  """Pod-style training hands off to robot-style serving: a model
+  trained with fsdp-sharded state exports a SavedModel (the exporter
+  gathers shards host-side) and the predictor round-trips it."""
+  from tensor2robot_tpu.export import (
+      SavedModelExportGenerator,
+      latest_export_dir,
+  )
+  from tensor2robot_tpu.parallel import mesh as mesh_lib
+  from tensor2robot_tpu.predictors import SavedModelPredictor
+  from tensor2robot_tpu.specs import make_random_tensors
+
+  mesh = mesh_lib.create_mesh({"data": 4, "fsdp": 2})
+  model = MockT2RModel(hidden_sizes=(64,))
+  model_dir = str(tmp_path / "m")
+  train_eval.train_eval_model(
+      model=model,
+      model_dir=model_dir,
+      input_generator_train=RandomInputGenerator(batch_size=16),
+      max_train_steps=5,
+      save_checkpoints_steps=5,
+      mesh=mesh,
+      sharding_strategy="fsdp",
+      min_size_to_shard=64,
+      create_exporters_fn=lambda m: [SavedModelExportGenerator()],
+  )
+  export_base = SavedModelExportGenerator().export_dir_base(model_dir)
+  assert latest_export_dir(export_base) is not None
+  predictor = SavedModelPredictor(export_base)
+  assert predictor.restore(timeout_secs=0)
+  batch = make_random_tensors(
+      model.preprocessor.get_in_feature_specification(Mode.PREDICT),
+      batch_size=3, seed=7)
+  out = predictor.predict(
+      {k: np.asarray(v) for k, v in batch.to_flat_dict().items()})
+  values = np.asarray(list(out.values())[0])
+  assert values.shape[0] == 3
+  assert np.isfinite(values).all()
+
+
 def test_mesh_and_strategy_configurable_from_gin():
   """The full sharded-training surface is reachable from .gin files:
   mesh layout AND strategy are bindings, no Python required."""
