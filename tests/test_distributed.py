@@ -24,7 +24,7 @@ def _free_port() -> int:
     return s.getsockname()[1]
 
 
-def test_two_process_cluster_runs_sharded_train_step():
+def test_two_process_cluster_runs_sharded_train_step(tmp_path):
   repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
   worker = os.path.join(repo, "tests", "distributed_worker.py")
   coordinator = f"127.0.0.1:{_free_port()}"
@@ -37,6 +37,8 @@ def test_two_process_cluster_runs_sharded_train_step():
   env["JAX_COORDINATOR_ADDRESS"] = coordinator
   env["JAX_NUM_PROCESSES"] = "2"
   env["TF_CPP_MIN_LOG_LEVEL"] = "2"
+  # Shared dir for the cross-process sharded-checkpoint round trip.
+  env["T2R_TEST_CKPT_DIR"] = str(tmp_path / "ckpt")
 
   procs = []
   try:
@@ -70,6 +72,10 @@ def test_two_process_cluster_runs_sharded_train_step():
     pid, loss = marker[0].split()[1:]
     assert int(pid) == i
     losses.append(float(loss))
+    # The sharded checkpoint round-trip (each process saving only its
+    # addressable shards, restore + cross-process checksum) ran too.
+    assert any(line.startswith("CKPT_OK") for line in
+               out.splitlines()), f"worker {i}: no CKPT_OK:\n{out[-2000:]}"
   # Replicated metrics: both processes must see the SAME global loss —
   # the signature of one SPMD program spanning both, not two
   # independent runs.
