@@ -1,0 +1,101 @@
+"""Measures the GPipe bubble of the pipelined trunk on a virtual mesh.
+
+Invoked by `bench.py --pipeline` as a subprocess (this process must own
+jax's platform choice: the pipeline needs a multi-device mesh, and the
+bench session holds the single real TPU chip — so the schedule runs on
+an 8-virtual-device CPU mesh instead, the same fixture the test suite
+uses).
+
+What the number means: on this rig the 8 virtual devices serialize onto
+one core, so wall-clock is proportional to TOTAL device compute — which
+is exactly what the bubble inflates. A GPipe schedule with S stages and
+M microbatches executes (M+S-1) ticks on every device where the
+sequential trunk executes M·S stage-microbatch units in total, so
+
+    total-compute ratio   = S·(M+S-1) / (M·S) = (M+S-1)/M
+    per-device speedup    = M·S/(M+S-1)   (what a real S-device pod
+                            gains over running the whole stack on one
+                            device, compute-bound limit)
+
+The measured serialized ratio should approach (M+S-1)/M from above
+(ppermute/dispatch overhead rides on top); the gap IS the schedule
+overhead beyond the analytic bubble. Prints one JSON object on stdout.
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def _step_time(fn, *args, reps: int = 5) -> float:
+  fn(*args)  # compile + warm
+  best = np.inf
+  for _ in range(reps):
+    t0 = time.perf_counter()
+    jax.block_until_ready(fn(*args))
+    best = min(best, time.perf_counter() - t0)
+  return best
+
+
+def main():
+  from tensor2robot_tpu.layers.pipelined_transformer import (
+      PipelinedCausalTransformer,
+  )
+  from tensor2robot_tpu.parallel import DATA_AXIS, STAGE_AXIS, create_mesh
+
+  stages, width, depth, t, batch = 4, 64, 4, 64, 16
+  mesh = create_mesh({DATA_AXIS: 2, STAGE_AXIS: stages})
+  x = jnp.asarray(
+      np.random.default_rng(0).standard_normal((batch, t, 8)),
+      jnp.float32)
+
+  def trunk(num_micro, use_mesh):
+    return PipelinedCausalTransformer(
+        width=width, depth=depth, num_heads=2, max_len=t,
+        num_stages=stages, num_microbatches=num_micro,
+        mesh=mesh if use_mesh else None, dtype=jnp.float32)
+
+  variables = trunk(2, False).init(jax.random.PRNGKey(0), x)
+
+  def train_step(module):
+    def loss(v, x):
+      return jnp.sum(module.apply(v, x) ** 2)
+    return jax.jit(jax.value_and_grad(loss))
+
+  seq_dt = _step_time(train_step(trunk(2, False)), variables, x)
+  rows = []
+  for m in (2, 4, 8):
+    pp_dt = _step_time(train_step(trunk(m, True)), variables, x)
+    rows.append({
+        "num_microbatches": m,
+        "measured_serialized_ratio": round(pp_dt / seq_dt, 3),
+        "analytic_compute_ratio": round((m + stages - 1) / m, 3),
+        "implied_per_device_speedup_on_pod": round(
+            m * stages / (m + stages - 1), 2),
+    })
+
+  print(json.dumps({
+      "config": (f"pipelined trunk S={stages} W={width} D={depth} "
+                 f"T={t} B={batch}, fwd+bwd, 8-device virtual CPU "
+                 "mesh (serialized: wall ∝ total device compute) vs "
+                 "the sequential fallback on the same params"),
+      "sequential_step_ms": round(seq_dt * 1e3, 1),
+      "bubble_rows": rows,
+  }))
+  return 0
+
+
+if __name__ == "__main__":
+  sys.exit(main())

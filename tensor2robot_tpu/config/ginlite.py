@@ -556,10 +556,11 @@ def _parse_statement(stmt: str, skip_unknown: bool = False) -> None:
       _REGISTRY.bindings.setdefault((scope, canonical), {})[param] = value
 
 
-# Search order for config paths: cwd, the directory of the file being
-# parsed (sibling-relative includes), any user-registered search paths
-# (add_config_file_search_path — these must outrank the built-in
-# fallback so users can shadow shipped configs), and LAST the
+# Search order for config paths: cwd, any user-registered search paths
+# (add_config_file_search_path — these outrank sibling-relative
+# resolution AND the built-in fallback, so users can shadow shipped
+# configs including their sibling includes), then the directory of the
+# file being parsed (sibling-relative includes), and LAST the
 # repo/package root, so the shipped `tensor2robot_tpu/...`
 # repo-relative include paths resolve regardless of the caller's cwd
 # (reference gin configs used the same repo-relative convention).
@@ -574,9 +575,10 @@ def add_config_file_search_path(path: str) -> None:
 
 
 def parse_config_file(path: str, skip_unknown: bool = False) -> None:
-  bases = list(_SEARCH_PATHS) + [_PACKAGE_ROOT]
+  bases = list(_SEARCH_PATHS)
   if _INCLUDE_DIR_STACK:
-    bases.insert(1, _INCLUDE_DIR_STACK[-1])
+    bases.append(_INCLUDE_DIR_STACK[-1])
+  bases.append(_PACKAGE_ROOT)
   for base in bases:
     candidate = os.path.join(base, path) if base else path
     if os.path.exists(candidate):

@@ -1,0 +1,133 @@
+"""Causal transformer trunk whose blocks run as pipeline stages.
+
+The reference has no pipeline parallelism (SURVEY.md §3 marks PP "not
+needed for these CNN-scale models"); `parallel/pipeline.py` provides
+the GPipe schedule as a library primitive. This module makes it a
+FRAMEWORK capability: a drop-in trunk whose depth is split into
+`num_stages` equal stages, with the stage weights stacked under one
+``stages`` param subtree (the name `pipeline_sharding` keys on) and
+the schedule driven by `pipeline_apply`. A gin config can therefore
+select a pipelined model + ``sharding_strategy="pipeline"`` and train
+through `train_eval_model` with no hand-wiring — the contract the MoE
+trunk already has for expert parallelism.
+
+Checkpoint portability: without a mesh (or without a `stage` axis)
+`pipeline_apply` falls back to a sequential scan over the SAME stacked
+params — identical math, so a pod-trained pipelined checkpoint serves
+on one chip unchanged (tests pin the pipelined and sequential outputs
+equal to f32 tolerance).
+
+Embedding, learned positions, and the final LayerNorm mirror
+`transformer.CausalTransformer`; only the block stack differs (every
+stage must be shape-preserving, which pre-LN blocks are).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tensor2robot_tpu.layers.transformer import TransformerBlock
+from tensor2robot_tpu.parallel.pipeline import (
+    init_stage_params,
+    pipeline_apply,
+)
+
+# Param-name contract `pipeline_sharding` keys on: every leaf under a
+# path segment with this name carries a leading [num_stages] dim.
+STAGE_PARAMS_NAME = "stages"
+
+
+class _StageBlocks(nn.Module):
+  """One pipeline stage: `blocks_per_stage` pre-LN transformer blocks."""
+
+  blocks_per_stage: int
+  num_heads: int
+  head_dim: int
+  attention_impl: str
+  causal: bool
+  dtype: Any
+
+  @nn.compact
+  def __call__(self, x: jax.Array) -> jax.Array:
+    for i in range(self.blocks_per_stage):
+      x = TransformerBlock(
+          num_heads=self.num_heads, head_dim=self.head_dim,
+          attention_impl=self.attention_impl, causal=self.causal,
+          dtype=self.dtype, name=f"block{i}")(x)
+    return x
+
+
+class PipelinedCausalTransformer(nn.Module):
+  """Embedding + positions + (depth/num_stages blocks) × num_stages.
+
+  Matches `CausalTransformer`'s input/output contract ([B, T, F] →
+  [B, T, width]) so model families can swap trunks by config. The
+  stage weights live stacked as one ``stages`` param (leading
+  [num_stages] dim on every leaf); with `mesh` carrying a `stage`
+  axis of exactly `num_stages` devices, `pipeline_apply` runs the
+  GPipe microbatch schedule over it, each device materializing one
+  stage. B must divide into `num_microbatches` × the mesh's data-axis
+  size (static shapes — the batch comes from specs).
+  """
+
+  width: int
+  depth: int
+  num_heads: int
+  max_len: int
+  num_stages: int
+  num_microbatches: int = 2
+  remat: bool = False
+  attention_impl: str = "reference"
+  causal: bool = True
+  mesh: Optional[Any] = None
+  dtype: Any = jnp.bfloat16
+
+  @nn.compact
+  def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+    b, t, _ = x.shape
+    if isinstance(t, int) and t > self.max_len:
+      raise ValueError(f"sequence length {t} > max_len {self.max_len}")
+    if self.width % self.num_heads:
+      raise ValueError(
+          f"width {self.width} must divide evenly into "
+          f"{self.num_heads} heads.")
+    if self.num_stages < 1 or self.depth % self.num_stages:
+      raise ValueError(
+          f"depth {self.depth} must split into num_stages="
+          f"{self.num_stages} equal shape-preserving stages.")
+    head_dim = self.width // self.num_heads
+
+    x = nn.Dense(self.width, dtype=self.dtype, name="embed")(
+        x.astype(self.dtype))
+    positions = self.param(
+        "positions", nn.initializers.normal(0.02),
+        (self.max_len, self.width))
+    pos_t = jnp.take(positions, jnp.arange(t), axis=0, mode="clip")
+    x = x + pos_t[None].astype(self.dtype)
+
+    stage = _StageBlocks(
+        blocks_per_stage=self.depth // self.num_stages,
+        num_heads=self.num_heads, head_dim=head_dim,
+        attention_impl=self.attention_impl, causal=self.causal,
+        dtype=self.dtype)
+    # One pytree-valued param: every leaf gains a leading [S] dim,
+    # nested under the `stages` name — the contract state_sharding's
+    # "pipeline" strategy keys on. Init shapes are T-independent
+    # (blocks have no positional state), so a minimal sample batch
+    # keeps init cheap at any context length.
+    sample = jnp.zeros((1, min(8, t), self.width), self.dtype)
+    stage_params = self.param(
+        STAGE_PARAMS_NAME,
+        lambda rng: init_stage_params(
+            lambda r: stage.init(r, sample)["params"],
+            rng, self.num_stages))
+    x = pipeline_apply(
+        lambda p, h: stage.apply({"params": p}, h),
+        stage_params, x, mesh=self.mesh,
+        num_microbatches=self.num_microbatches, remat=self.remat)
+    return nn.LayerNorm(dtype=self.dtype, name="ln_out")(
+        x).astype(jnp.float32)

@@ -298,6 +298,11 @@ class AbstractT2RModel(ModelInterface):
     loss, scalars = self.model_train_fn(features, labels, outputs, mode)
     if aux is not None:
       loss = loss + self._aux_loss_weight * aux
+      if "aux_loss" in scalars:
+        raise ValueError(
+            "model_train_fn reported a scalar named 'aux_loss'; that "
+            "key is reserved for the network-sown auxiliary loss "
+            f"({self.AUX_LOSS_OUTPUT}) — rename the subclass scalar.")
       scalars = {**scalars, "aux_loss": aux}
     return loss, (scalars, new_stats)
 
@@ -334,6 +339,11 @@ class AbstractT2RModel(ModelInterface):
            if isinstance(outputs, dict) else None)
     metrics = self.model_eval_fn(features, labels, outputs)
     if aux is not None:
+      if "aux_loss" in metrics:
+        raise ValueError(
+            "model_eval_fn reported a metric named 'aux_loss'; that "
+            "key is reserved for the network-sown auxiliary loss "
+            f"({self.AUX_LOSS_OUTPUT}) — rename the subclass metric.")
       metrics = {**metrics, "aux_loss": aux}
       # model_eval_fn's contract promises only "scalars" — a custom
       # override may not report a "loss" key at all.

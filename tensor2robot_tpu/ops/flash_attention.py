@@ -10,38 +10,46 @@ block_k) tiles, scores live only in registers/VMEM, and the online
 softmax carries running max/normalizer/accumulator in f32 scratch.
 
 Measured on v5e at T=32768 causal (scan-amortized, D2H-barriered):
-28.9 TFLOP/s ≈ 15% of bf16 peak at D=64 in the committed run
+28.9 TFLOP/s ≈ 15% of bf16 peak at D=64 in the committed round-4 run
 (session spread 24–29; see below for D=128) — where the materialized
-XLA attention OOMs beyond T≈4096. (Round 3 recorded 147 TFLOP/s for this kernel;
-that number does not reproduce under the hardened timing methodology
-and is retracted — see bench.py's docstring for why early numbers
-were tunnel artifacts.) The round-4 kernel is ~7× the honest round-3
-baseline: large default blocks amortize Mosaic's sequential-grid
-per-step overhead, fully-masked causal K-blocks skip compute under
-pl.when, and the lse is stored as (8, block_q) tiles instead of a
-128-lane broadcast (16× less lse HBM traffic). The remaining gap to
-peak is structural at D=64: the score/PV matmuls contract only 64
-lanes of the 128-wide MXU, and the online-softmax VPU work (exp,
-max, rescale) is comparable to the matmul time at these tile shapes.
-That argument is confirmed empirically: the SAME kernel at D=128
-(H halved, identical FLOPs) is consistently faster — 1.25× in the
-committed run (36.1 vs 28.9 TFLOP/s, `BENCH_DETAIL.json` →
+XLA attention OOMs beyond T≈4096. (Round 3 recorded 147 TFLOP/s for
+this kernel; that number does not reproduce under the hardened timing
+methodology and is retracted — see bench.py's docstring for why early
+numbers were tunnel artifacts.) The round-4 kernel is ~7× the honest
+round-3 baseline: large default blocks amortize Mosaic's
+sequential-grid per-step overhead, fully-masked causal K-blocks skip
+compute under pl.when, and the lse is stored as (8, block_q) tiles
+instead of a 128-lane broadcast (16× less lse HBM traffic). The
+remaining gap to peak is structural at D=64: the score/PV matmuls
+contract only 64 lanes of the 128-wide MXU, and the online-softmax VPU
+work (exp, max, rescale) is comparable to the matmul time at these
+tile shapes. That argument is confirmed empirically: the SAME kernel
+at D=128 (H halved, identical FLOPs) is consistently faster — 1.25×
+in the committed run (36.1 vs 28.9 TFLOP/s, `BENCH_DETAIL.json` →
 `long_context_d128` vs `long_context`), 1.8× in a quieter-tunnel
 session (43 vs 24). Models that care about attention throughput at
 long context should prefer MXU-width heads.
 
-Training works end to end: a custom VJP recomputes per-block scores
-from the saved logsumexp (the standard flash backward), scanned over
-(q-block, k-block) tiles so the backward is ALSO O(T) memory — no
-[T, T] tensor exists in either direction.
+Training works end to end, and the backward is Pallas too (new in
+round 5; the round-4 backward was a scanned XLA program — the per-op
+profile showed it dominated by relayouts of the blockwise einsums):
+two kernels in the standard flash-backward formulation, each
+recomputing score tiles from q/k + the saved logsumexp —
+`_dkdv_kernel` accumulates dk/dv per K-block over the Q grid,
+`_dq_kernel` accumulates dq per Q-block over the K grid. The
+softmax-jacobian row term D_i = rowsum(dO·O) (minus any lse
+cotangent) is a cheap XLA elementwise reduce computed once outside.
+No [T, T] tensor exists in either direction; causal work-skipping
+applies to both directions (fully-masked tile pairs skip under
+pl.when).
 
 Pairs with `parallel/ring_attention.py`: the ring shards the sequence
 ACROSS chips (ppermute over ICI), this kernel tiles it WITHIN a chip;
 both implement the same online-softmax math.
 
-`flash_attention(..., interpret=True)` runs the kernel in the pallas
-interpreter — how the CPU test suite verifies numerics without TPU
-hardware.
+`flash_attention(..., interpret=True)` runs the kernels (forward AND
+backward) in the pallas interpreter — how the CPU test suite verifies
+numerics without TPU hardware.
 """
 
 from __future__ import annotations
@@ -202,20 +210,152 @@ def _flash_forward_impl(q, k, v, causal: bool, block_q: int,
           lse[:, :, 0, :].reshape(b * h, t))
 
 
-def _flash_bwd_core(q, k, v, out, lse, do, dlse, causal, block_q,
-                    block_k):
-  """Standard flash backward, double-scanned over (q, k) blocks.
+def _transpose_tile(x):
+  """(1, n) → (n, 1) on the MXU (identity contraction).
 
-  Recomputes each [block_q, block_k] score tile from q/k + the saved
-  logsumexp; no [T, T] tensor is ever materialized, so the backward is
-  O(T) memory like the forward. Runs as plain XLA (f32 accumulation);
-  a dedicated pallas backward kernel is a future optimization.
+  The per-row lse/delta arrive as lane-major (1, block_q) tiles (the
+  dense storage layout) but broadcast against score tiles row-wise,
+  which needs the sublane-major (block_q, 1) layout; Mosaic cannot
+  reshape across the sublane/lane boundary, so transpose by
+  contracting against an identity — one (n×n)·(n×1) matmul, noise
+  next to the (bq×D)·(D×bk) score matmul.
+  """
+  n = x.shape[-1]
+  return jax.lax.dot_general(
+      jnp.eye(n, dtype=jnp.float32), x.astype(jnp.float32),
+      (((1,), (1,)), ((), ())))
+
+
+def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                 dk_ref, dv_ref, dk_scr, dv_scr, *, scale: float,
+                 causal: bool, block_q: int, block_k: int,
+                 num_q_blocks: int):
+  """Grid (B*H, T/block_k, T/block_q); the innermost dim iterates Q
+  blocks sequentially, accumulating this K-block's dk/dv in VMEM
+  scratch from recomputed p = exp(s − lse) tiles; the last Q step
+  writes out."""
+  j = pl.program_id(1)
+  qi = pl.program_id(2)
+
+  @pl.when(qi == 0)
+  def _init():
+    dk_scr[...] = jnp.zeros_like(dk_scr)
+    dv_scr[...] = jnp.zeros_like(dv_scr)
+
+  mask = None
+  if causal:
+    rows = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    cols = j * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    mask = cols <= rows
+
+  def _update():
+    q = q_ref[0]                                   # [bq, D]
+    k = k_ref[0]                                   # [bk, D]
+    v = v_ref[0]
+    do = do_ref[0]                                 # [bq, D]
+    lse = _transpose_tile(lse_ref[...])            # [bq, 1]
+    delta = _transpose_tile(delta_ref[...])        # [bq, 1]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale  # [bq, bk]
+    if causal:
+      s = jnp.where(mask, s, _NEG_INF)
+    p = jnp.exp(s - lse)
+    if causal:
+      p = jnp.where(mask, p, 0.0)
+    # dv += pᵀ·dO. p/ds cast to the input dtype for the MXU matmul
+    # (f32 accumulation via preferred_element_type) — the standard
+    # flash-backward precision contract, bit-exact in f32 tests.
+    dv_scr[...] += jax.lax.dot_general(
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)          # [bq, bk]
+    ds = p * (dp - delta) * scale
+    dk_scr[...] += jax.lax.dot_general(
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+  if causal:
+    # A Q block fully ABOVE this K block (every row < every col) is
+    # fully masked: skip — the backward mirror of the forward's
+    # future-K skip, half the grid at long T.
+    pl.when(qi * block_q + block_q - 1 >= j * block_k)(_update)
+  else:
+    _update()
+
+  @pl.when(qi == num_q_blocks - 1)
+  def _finalize():
+    dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+    dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               dq_ref, dq_scr, *, scale: float, causal: bool,
+               block_q: int, block_k: int, num_k_blocks: int):
+  """Grid (B*H, T/block_q, T/block_k); innermost iterates K blocks,
+  accumulating this Q-block's dq = Σ_j ds_j·k_j in VMEM scratch."""
+  i = pl.program_id(1)
+  kj = pl.program_id(2)
+
+  @pl.when(kj == 0)
+  def _init():
+    dq_scr[...] = jnp.zeros_like(dq_scr)
+
+  mask = None
+  if causal:
+    rows = i * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    cols = kj * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    mask = cols <= rows
+
+  def _update():
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    do = do_ref[0]
+    lse = _transpose_tile(lse_ref[...])
+    delta = _transpose_tile(delta_ref[...])
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    if causal:
+      s = jnp.where(mask, s, _NEG_INF)
+    p = jnp.exp(s - lse)
+    if causal:
+      p = jnp.where(mask, p, 0.0)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    ds = p * (dp - delta) * scale
+    dq_scr[...] += jax.lax.dot_general(
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+  if causal:
+    # Fully-future K blocks contribute zero ds: same skip as forward.
+    pl.when(kj * block_k <= i * block_q + block_q - 1)(_update)
+  else:
+    _update()
+
+  @pl.when(kj == num_k_blocks - 1)
+  def _finalize():
+    dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _flash_bwd_impl(q, k, v, out, lse, do, dlse, causal: bool,
+                    block_q: int, block_k: int, interpret: bool):
+  """Pallas flash backward: dkdv kernel + dq kernel.
 
   `dlse` ([BH, T]) is the cotangent of the logsumexp output — zeros
   when the caller only used `out`: since ∂lse_i/∂s_ij = p_ij, it
   folds into the softmax-jacobian diagonal as ds = p·(dp − (δ − g)) —
-  one subtraction, which is what makes the lse-composed ring
-  attention trainable through this kernel.
+  one subtraction in the precomputed per-row term, which is what makes
+  the lse-composed ring attention trainable through this kernel.
   """
   b, t, h, d = q.shape
   scale = 1.0 / np.sqrt(d)
@@ -224,65 +364,68 @@ def _flash_bwd_core(q, k, v, out, lse, do, dlse, causal, block_q,
   def fold(x):  # [B, T, H, D] -> [B*H, T, D]
     return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
 
-  q_f = fold(q).astype(jnp.float32)
-  k_f = fold(k).astype(jnp.float32)
-  v_f = fold(v).astype(jnp.float32)
-  do_f = fold(do).astype(jnp.float32)
-  o_f = fold(out).astype(jnp.float32)
-  # D_i = rowsum(dO * O): the softmax-jacobian diagonal correction.
-  delta = jnp.sum(do_f * o_f, axis=-1)  # [BH, T]
-  delta = delta - dlse.astype(jnp.float32)
+  q_f, k_f, v_f, do_f, o_f = map(fold, (q, k, v, do, out))
+  # δ_i = rowsum(dO·O) − dlse_i: the softmax-jacobian row term, a
+  # cheap elementwise reduce XLA fuses; both kernels read it as dense
+  # (1, block_q) lane tiles alongside the lse.
+  delta = (jnp.sum(do_f.astype(jnp.float32) * o_f.astype(jnp.float32),
+                   axis=-1)
+           - dlse.astype(jnp.float32))              # [BH, T]
+  lse = lse.astype(jnp.float32)
 
-  q_b = q_f.reshape(b * h, nq, block_q, d)
-  do_b = do_f.reshape(b * h, nq, block_q, d)
-  lse_b = lse.reshape(b * h, nq, block_q)
-  delta_b = delta.reshape(b * h, nq, block_q)
-  k_b = k_f.reshape(b * h, nk, block_k, d)
-  v_b = v_f.reshape(b * h, nk, block_k, d)
+  dk_f, dv_f = pl.pallas_call(
+      functools.partial(_dkdv_kernel, scale=scale, causal=causal,
+                        block_q=block_q, block_k=block_k,
+                        num_q_blocks=nq),
+      grid=(b * h, nk, nq),
+      in_specs=[
+          pl.BlockSpec((1, block_q, d), lambda g, j, i: (g, i, 0)),
+          pl.BlockSpec((1, block_k, d), lambda g, j, i: (g, j, 0)),
+          pl.BlockSpec((1, block_k, d), lambda g, j, i: (g, j, 0)),
+          pl.BlockSpec((1, block_q, d), lambda g, j, i: (g, i, 0)),
+          pl.BlockSpec((1, block_q), lambda g, j, i: (g, i)),
+          pl.BlockSpec((1, block_q), lambda g, j, i: (g, i)),
+      ],
+      out_specs=[
+          pl.BlockSpec((1, block_k, d), lambda g, j, i: (g, j, 0)),
+          pl.BlockSpec((1, block_k, d), lambda g, j, i: (g, j, 0)),
+      ],
+      out_shape=[
+          jax.ShapeDtypeStruct((b * h, t, d), k.dtype),
+          jax.ShapeDtypeStruct((b * h, t, d), v.dtype),
+      ],
+      scratch_shapes=[
+          pltpu.VMEM((block_k, d), jnp.float32),   # dk accumulator
+          pltpu.VMEM((block_k, d), jnp.float32),   # dv accumulator
+      ],
+      interpret=interpret,
+  )(q_f, k_f, v_f, do_f, lse, delta)
 
-  def q_block_step(carry, qi):
-    dk_acc, dv_acc = carry
-    qq = q_b[:, qi]          # [BH, bq, D]
-    ddo = do_b[:, qi]
-    ll = lse_b[:, qi]        # [BH, bq]
-    dd = delta_b[:, qi]
+  dq_f = pl.pallas_call(
+      functools.partial(_dq_kernel, scale=scale, causal=causal,
+                        block_q=block_q, block_k=block_k,
+                        num_k_blocks=nk),
+      grid=(b * h, nq, nk),
+      in_specs=[
+          pl.BlockSpec((1, block_q, d), lambda g, i, j: (g, i, 0)),
+          pl.BlockSpec((1, block_k, d), lambda g, i, j: (g, j, 0)),
+          pl.BlockSpec((1, block_k, d), lambda g, i, j: (g, j, 0)),
+          pl.BlockSpec((1, block_q, d), lambda g, i, j: (g, i, 0)),
+          pl.BlockSpec((1, block_q), lambda g, i, j: (g, i)),
+          pl.BlockSpec((1, block_q), lambda g, i, j: (g, i)),
+      ],
+      out_specs=[
+          pl.BlockSpec((1, block_q, d), lambda g, i, j: (g, i, 0)),
+      ],
+      out_shape=[jax.ShapeDtypeStruct((b * h, t, d), q.dtype)],
+      scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+      interpret=interpret,
+  )(q_f, k_f, v_f, do_f, lse, delta)[0]
 
-    def k_block_step(dq_acc, kj):
-      kk = k_b[:, kj]        # [BH, bk, D]
-      vv = v_b[:, kj]
-      s = jnp.einsum("zqd,zkd->zqk", qq, kk) * scale
-      if causal:
-        rows = qi * block_q + jnp.arange(block_q)
-        cols = kj * block_k + jnp.arange(block_k)
-        mask = cols[None, :] <= rows[:, None]
-        s = jnp.where(mask[None], s, _NEG_INF)
-      p = jnp.exp(s - ll[..., None])  # [BH, bq, bk]
-      if causal:
-        p = jnp.where(mask[None], p, 0.0)
-      dv_blk = jnp.einsum("zqk,zqd->zkd", p, ddo)
-      dp = jnp.einsum("zqd,zkd->zqk", ddo, vv)
-      ds = p * (dp - dd[..., None]) * scale
-      dq_blk = jnp.einsum("zqk,zkd->zqd", ds, kk)
-      dk_blk = jnp.einsum("zqk,zqd->zkd", ds, qq)
-      return dq_acc + dq_blk, (dk_blk, dv_blk)
+  def unfold(x):  # [BH, T, D] -> [B, T, H, D]
+    return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
-    dq, (dk_blks, dv_blks) = jax.lax.scan(
-        k_block_step, jnp.zeros_like(qq), jnp.arange(nk))
-    return (dk_acc + dk_blks, dv_acc + dv_blks), dq
-
-  (dk_blks, dv_blks), dq_blks = jax.lax.scan(
-      q_block_step,
-      (jnp.zeros((nk, b * h, block_k, d), jnp.float32),
-       jnp.zeros((nk, b * h, block_k, d), jnp.float32)),
-      jnp.arange(nq))
-
-  def unfold(x_bh_t_d):  # [BH, T, D] -> [B, T, H, D]
-    return x_bh_t_d.reshape(b, h, t, d).transpose(0, 2, 1, 3)
-
-  dq = unfold(dq_blks.transpose(1, 0, 2, 3).reshape(b * h, t, d))
-  dk = unfold(dk_blks.transpose(1, 0, 2, 3).reshape(b * h, t, d))
-  dv = unfold(dv_blks.transpose(1, 0, 2, 3).reshape(b * h, t, d))
-  return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+  return unfold(dq_f), unfold(dk_f), unfold(dv_f)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -299,11 +442,10 @@ def _flash_lse_fwd(q, k, v, causal, block_q, block_k, interpret):
 
 def _flash_lse_bwd(causal, block_q, block_k, interpret, residuals,
                    cotangents):
-  del interpret
   q, k, v, out, lse = residuals
   do, dlse = cotangents
-  return _flash_bwd_core(q, k, v, out, lse, do, dlse, causal, block_q,
-                         block_k)
+  return _flash_bwd_impl(q, k, v, out, lse, do, dlse, causal, block_q,
+                         block_k, interpret)
 
 
 _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
@@ -356,9 +498,9 @@ def flash_attention(
   Block sizes auto-shrink to divide T (`_auto_block`), so any static
   T works; power-of-two T keeps the large overhead-amortizing blocks.
   Differentiable via the flash custom VJP (logsumexp residual +
-  blockwise recompute); shares `_flash_lse`'s backward — the dropped
-  lse output contributes a zero cotangent, so there is exactly ONE
-  backward implementation to keep correct.
+  blockwise Pallas recompute); shares `_flash_lse`'s backward — the
+  dropped lse output contributes a zero cotangent, so there is exactly
+  ONE backward implementation to keep correct.
   """
   b, t, h, d = q.shape
   block_q = _auto_block(block_q, t)

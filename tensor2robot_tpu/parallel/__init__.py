@@ -35,6 +35,7 @@ from tensor2robot_tpu.parallel.ring_attention import (
 from tensor2robot_tpu.parallel.sharding import (
     expert_sharding,
     fsdp_sharding,
+    pipeline_sharding,
     state_sharding,
     tensor_parallel_sharding,
 )

@@ -27,6 +27,7 @@ from tensor2robot_tpu.parallel.mesh import (
     EXPERT_AXIS,
     FSDP_AXIS,
     MODEL_AXIS,
+    STAGE_AXIS,
     replicated,
 )
 
@@ -96,26 +97,71 @@ def expert_sharding(mesh: Mesh, tree: Any,
                     min_size_to_shard: int = 2 ** 10) -> Any:
   """fsdp rules + expert weights sharded over the `expert` axis.
 
-  Keys on the `MoEMLP` param-name contract: leaves whose path contains
-  an ``expert_``-prefixed name (the stacked [E, ...] expert weights)
-  put their leading expert dim on `expert`; everything else (router,
-  attention, dense trunk — and every optimizer mirror, which shares
-  its param's path) follows the fsdp rule. With no `expert` mesh axis
-  this IS `fsdp_sharding`.
+  Keys on the `MoEMLP` param-name contract: a leaf is an expert weight
+  iff its own name is ``expert_``-prefixed (the stacked [E, ...] expert
+  weights) AND it lives directly under a ``moe`` module (the name the
+  transformer trunk instantiates `MoEMLP` as) or at the tree root (a
+  bare `MoEMLP` param tree). Matching leaves put their leading expert
+  dim on `expert`; an indivisible leading dim raises (silently falling
+  back to fsdp would replicate expert weights a pod expects sharded).
+  Everything else (router, attention, dense trunk — and every optimizer
+  mirror, which shares its param's path) follows the fsdp rule. With no
+  `expert` mesh axis this IS `fsdp_sharding`.
   """
   if EXPERT_AXIS not in mesh.axis_names:
     return fsdp_sharding(mesh, tree, min_size_to_shard)
   size = mesh.shape[EXPERT_AXIS]
 
+  def _name(key) -> str:
+    return str(getattr(key, "key", getattr(key, "name", "")))
+
   def rule(path, leaf):
     shape = getattr(leaf, "shape", ())
-    is_expert = any(
-        str(getattr(key, "key", getattr(key, "name", ""))).startswith(
-            "expert_") for key in path)
-    if is_expert and shape and shape[0] % size == 0:
+    is_expert = (path and _name(path[-1]).startswith("expert_")
+                 and (len(path) == 1 or _name(path[-2]) == "moe"))
+    if is_expert:
+      if not shape or shape[0] % size != 0:
+        raise ValueError(
+            f"expert weight {jax.tree_util.keystr(path)} has leading "
+            f"dim {shape[:1]} not divisible by expert axis size {size}")
       return NamedSharding(mesh, P(EXPERT_AXIS))
     # A single array is its own pytree: fsdp_sharding returns the
     # one NamedSharding its rule picks for this leaf.
+    return fsdp_sharding(mesh, leaf, min_size_to_shard)
+
+  return jax.tree_util.tree_map_with_path(rule, tree)
+
+
+def pipeline_sharding(mesh: Mesh, tree: Any,
+                      min_size_to_shard: int = 2 ** 10) -> Any:
+  """fsdp rules + stage-stacked weights sharded over the `stage` axis.
+
+  Keys on the `PipelinedCausalTransformer` param-name contract
+  (`layers/pipelined_transformer.STAGE_PARAMS_NAME`): every leaf under
+  a path segment named ``stages`` carries a leading [num_stages] dim
+  and puts it on `stage` — each device materializes only its own
+  stage's weights (and their optimizer mirrors, which share the path).
+  An indivisible leading dim raises: silently replicating stage
+  weights would defeat the memory win pipelining exists for. With no
+  `stage` mesh axis this IS `fsdp_sharding` (the sequential-fallback
+  layout `pipeline_apply` runs against).
+  """
+  if STAGE_AXIS not in mesh.axis_names:
+    return fsdp_sharding(mesh, tree, min_size_to_shard)
+  size = mesh.shape[STAGE_AXIS]
+
+  def _name(key) -> str:
+    return str(getattr(key, "key", getattr(key, "name", "")))
+
+  def rule(path, leaf):
+    shape = getattr(leaf, "shape", ())
+    if any(_name(key) == "stages" for key in path):
+      if not shape or shape[0] % size != 0:
+        raise ValueError(
+            f"stage-stacked weight {jax.tree_util.keystr(path)} has "
+            f"leading dim {shape[:1]} not divisible by stage axis "
+            f"size {size}")
+      return NamedSharding(mesh, P(STAGE_AXIS))
     return fsdp_sharding(mesh, leaf, min_size_to_shard)
 
   return jax.tree_util.tree_map_with_path(rule, tree)
@@ -140,5 +186,6 @@ def state_sharding(mesh: Mesh, state: Any,
   rule_fn = {"fsdp": fsdp_sharding,
              "tp": tensor_parallel_sharding,
              "ep": expert_sharding,
+             "pipeline": pipeline_sharding,
              "replicated": replicated_sharding}[strategy]
   return rule_fn(mesh, state, min_size_to_shard=min_size_to_shard)

@@ -56,6 +56,9 @@ class _EpisodeTransformerNet(nn.Module):
   dtype: Any = jnp.bfloat16
   moe_experts: int = 0
   moe_every: int = 2
+  pipeline_stages: int = 0
+  pipeline_microbatches: int = 2
+  pipeline_remat: bool = False
 
   @nn.compact
   def __call__(self, features, train: bool = False):
@@ -75,12 +78,27 @@ class _EpisodeTransformerNet(nn.Module):
         use_batch_norm=False, dtype=self.dtype,
         name="obs_encoder")(folded, train=train)
     emb = emb.reshape(b, t, -1)
-    trunk = CausalTransformer(
-        width=self.width, depth=self.depth, num_heads=self.num_heads,
-        max_len=self.max_len, attention_impl=self.attention_impl,
-        causal=True, mesh=self.mesh, dtype=self.dtype,
-        moe_experts=self.moe_experts, moe_every=self.moe_every,
-        name="trunk")(emb, train=train)
+    if self.pipeline_stages:
+      from tensor2robot_tpu.layers.pipelined_transformer import (
+          PipelinedCausalTransformer,
+      )
+      trunk = PipelinedCausalTransformer(
+          width=self.width, depth=self.depth,
+          num_heads=self.num_heads, max_len=self.max_len,
+          num_stages=self.pipeline_stages,
+          num_microbatches=self.pipeline_microbatches,
+          remat=self.pipeline_remat,
+          attention_impl=self.attention_impl, causal=True,
+          mesh=self.mesh, dtype=self.dtype,
+          name="trunk")(emb, train=train)
+    else:
+      trunk = CausalTransformer(
+          width=self.width, depth=self.depth,
+          num_heads=self.num_heads, max_len=self.max_len,
+          attention_impl=self.attention_impl,
+          causal=True, mesh=self.mesh, dtype=self.dtype,
+          moe_experts=self.moe_experts, moe_every=self.moe_every,
+          name="trunk")(emb, train=train)
     action = nn.Dense(self.action_dim, dtype=self.dtype,
                       name="action_head")(
         trunk.astype(self.dtype)).astype(jnp.float32)
@@ -105,6 +123,9 @@ class VRGripperTransformerModel(AbstractT2RModel):
                mesh: Optional[Any] = None,
                moe_experts: int = 0,
                moe_every: int = 2,
+               pipeline_stages: int = 0,
+               pipeline_microbatches: int = 2,
+               pipeline_remat: bool = False,
                device_dtype=jnp.bfloat16,
                **kwargs):
     """`mesh`: required for attention_impl="ring"/"ring_flash" — the
@@ -113,8 +134,22 @@ class VRGripperTransformerModel(AbstractT2RModel):
     `moe_experts`/`moe_every`: swap every `moe_every`-th block's MLP
     for that many routed experts (`parallel/moe.py`); with a mesh
     `expert` axis they run expert-parallel, and the load-balance aux
-    loss joins training via the base model's aux_loss_weight."""
+    loss joins training via the base model's aux_loss_weight.
+    `pipeline_stages`: split the trunk's depth into that many GPipe
+    stages (`layers/pipelined_transformer.py`); with a mesh `stage`
+    axis of the same size + sharding_strategy="pipeline" each device
+    holds one stage's weights and activations ppermute through the
+    microbatch schedule. Without a stage axis the SAME params run the
+    sequential fallback — pod-trained checkpoints serve on one chip.
+    The global batch must divide into pipeline_microbatches × the
+    mesh's data-axis size (set train_eval_model.init_batch_size
+    accordingly). Mutually exclusive with moe_experts (one trunk)."""
     super().__init__(device_dtype=device_dtype, **kwargs)
+    if pipeline_stages and moe_experts:
+      raise ValueError(
+          "pipeline_stages and moe_experts are mutually exclusive: "
+          "the pipelined trunk stacks dense blocks (stage-stacked MoE "
+          "routing is not implemented).")
     self._image_size = image_size
     self._state_dim = state_dim
     self._action_dim = action_dim
@@ -128,6 +163,17 @@ class VRGripperTransformerModel(AbstractT2RModel):
     self._mesh = mesh
     self._moe_experts = moe_experts
     self._moe_every = moe_every
+    self._pipeline_stages = pipeline_stages
+    self._pipeline_microbatches = pipeline_microbatches
+    self._pipeline_remat = pipeline_remat
+    if pipeline_stages and mesh is not None:
+      from tensor2robot_tpu.parallel.mesh import STAGE_AXIS
+      if (STAGE_AXIS in mesh.axis_names
+          and mesh.shape[STAGE_AXIS] != pipeline_stages):
+        raise ValueError(
+            f"pipeline_stages={pipeline_stages} must equal the mesh's "
+            f"{STAGE_AXIS!r} axis size {mesh.shape[STAGE_AXIS]} (each "
+            "device materializes exactly one stage).")
     if mesh is not None:
       from tensor2robot_tpu.parallel.mesh import SEQ_AXIS
       if (SEQ_AXIS in mesh.axis_names
@@ -182,6 +228,9 @@ class VRGripperTransformerModel(AbstractT2RModel):
         mesh=self._mesh,
         moe_experts=self._moe_experts,
         moe_every=self._moe_every,
+        pipeline_stages=self._pipeline_stages,
+        pipeline_microbatches=self._pipeline_microbatches,
+        pipeline_remat=self._pipeline_remat,
         dtype=self.device_dtype,
     )
 

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import glob
 import os
+import re
 from typing import Dict, Iterator, List, Tuple
 
 
@@ -110,22 +111,43 @@ def op_times_ms(trace_dir: str,
   return totals
 
 
+_ASYNC_WINDOW = re.compile(
+    r"%(copy|fusion|all-gather|all-reduce|reduce-scatter"
+    r"|collective-permute|all-to-all|send|recv)[\w.]*-(start|done)")
+
+
+def is_async_window(name: str) -> bool:
+  """True for async -start/-done events (copy/collective windows).
+
+  Their recorded durations are WALL SPANS that overlap compute —
+  prefetch/communication windows, not busy time — so a table meant to
+  attribute device time to compute must drop them (the round-4 lesson:
+  both committed top_ops tables were 10/10 copy-starts, attributing
+  nothing).
+  """
+  return bool(_ASYNC_WINDOW.match(name))
+
+
 def top_ops(trace_dir: str, k: int = 20,
             plane_filter: str = "TPU",
-            hlo_only: bool = False) -> List[Tuple[str, float]]:
+            hlo_only: bool = False,
+            compute_only: bool = False) -> List[Tuple[str, float]]:
   """Top-k (op name, device ms) pairs, descending.
 
   `hlo_only` keeps only leaf HLO instruction events: names must start
   with '%', and '%while'-prefixed spans are dropped too — a while
   instruction is itself an umbrella covering every loop iteration's
   ops, so it would top the table with ~the whole dispatch attributed
-  to one "op". Async copy-start events remain: their durations are
-  wall spans that OVERLAP compute, so read them as prefetch windows,
-  not busy time.
+  to one "op". `compute_only` additionally drops async -start/-done
+  window events (see `is_async_window`), leaving fusions/convs/
+  matmuls whose durations are actual busy time and sum to ≈ the
+  dispatch's device time.
   """
   totals = op_times_ms(trace_dir, plane_filter)
   items = totals.items()
   if hlo_only:
     items = [(n, v) for n, v in items
              if n.startswith("%") and not n.startswith("%while")]
+  if compute_only:
+    items = [(n, v) for n, v in items if not is_async_window(n)]
   return sorted(items, key=lambda kv: -kv[1])[:k]

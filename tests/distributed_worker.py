@@ -111,7 +111,7 @@ def main():
     writer = ckpt_lib.CheckpointWriter(ckpt_dir, max_to_keep=1)
     writer.save(0, {"w": w})
     writer.close()
-    restored = ckpt_lib.restore_state(ckpt_dir, like=w, step=0)["w"]
+    restored = ckpt_lib.restore_state(ckpt_dir, like={"w": w}, step=0)["w"]
     for shard in restored.addressable_shards:
       np.testing.assert_array_equal(
           np.asarray(shard.data), global_w[shard.index])
@@ -121,7 +121,7 @@ def main():
         jax.shard_map(lambda x: jax.lax.psum(jnp.sum(x), "data"),
                       mesh=mesh, in_specs=P("data"), out_specs=P()),
         out_shardings=NamedSharding(mesh, P()))(restored)
-    got_sum = float(np.asarray(jax.device_get(checksum))[0])
+    got_sum = float(np.asarray(jax.device_get(checksum)))
     assert got_sum == float(global_w.sum()), (got_sum, global_w.sum())
     print(f"CKPT_OK {jax.process_index()} {got_sum:.1f}", flush=True)
 

@@ -14,12 +14,16 @@ partitioned program's communication structure is a tested contract:
   * data×fsdp×model — plus tensor-parallel activation reductions
   * data×seq ring   — collective-permutes only (no sequence gather!)
 
-Counts are exact for the pinned jax/XLA in the image; if a toolchain
-bump legitimately changes them, update the constants alongside a check
-that the shape of the communication (which ops, roughly how many) still
-matches the layout's story.
+Counts are pinned EXACTLY only where the algorithm forces them (the
+MoE dispatch/return all-to-all pair, ring/pipeline permutes, the
+zero-gather guarantees). Counts the partitioner/combiner CHOOSES
+(fused gradient reduces, resharding all-to-alls, recompute gathers)
+are asserted as bounds or as differences between layouts — a compiler
+upgrade that merges two reshards is not a regression; a layout whose
+param gathers or gradient reduce disappear is.
 """
 
+import functools
 import re
 
 import numpy as np
@@ -69,8 +73,14 @@ def collective_counts(hlo_text: str):
   }
 
 
+@functools.lru_cache(maxsize=None)
 def compile_qtopt_step(axes, strategy):
-  """The exact sharded-train-step construction train_eval/dryrun use."""
+  """The exact sharded-train-step construction train_eval/dryrun use.
+
+  `axes` is a tuple of (name, size) pairs (hashable for the cache —
+  the comparative tests diff two layouts without recompiling).
+  """
+  axes = dict(axes)
   n = int(np.prod(list(axes.values())))
   mesh = create_mesh(axes, devices=jax.devices()[:n])
   model = GraspingQModel(
@@ -98,51 +108,60 @@ def compile_qtopt_step(axes, strategy):
 class TestTrainStepCollectives:
 
   def test_fsdp_mesh_gradient_reduce_and_param_gathers(self):
-    counts = compile_qtopt_step({DATA_AXIS: 4, FSDP_AXIS: 2}, "fsdp")
+    counts = compile_qtopt_step(
+        ((DATA_AXIS, 4), (FSDP_AXIS, 2)), "fsdp")
     # Gradient + metric reductions over data×fsdp, including the
     # TUPLE-form fused param-gradient all-reduce the pre-fix regex
     # missed entirely (this file asserted `all-reduce == 1` for two
     # rounds because only one scalar-typed reduce matched). Zero
-    # would mean device rows silently diverge.
-    assert counts["all-reduce"] == 9, counts
-    # Zero-style param/optimizer sharding: every fsdp-sharded tensor
-    # all-gathers for use (forward + recompute). Zero would mean the
-    # state silently replicated — the regression this file exists for.
-    # (Was 9 before the round-4 CEM-head concatenate rewrite; the
-    # head restructure let GSPMD merge two gathers.)
-    assert counts["all-gather"] == 7, counts
-    # This layout needs no permutes; the all-to-alls are
-    # partitioner-chosen reshards of batched activations between the
-    # batch-sharded and replicated-output layouts (tuple form, also
-    # invisible to the old regex).
+    # would mean device rows silently diverge. How many the combiner
+    # fuses into is its choice — pinned in round 4 as exactly 9; a
+    # bound survives toolchain bumps.
+    assert counts["all-reduce"] >= 1, counts
+    # Zero-style param/optimizer sharding: fsdp-sharded tensors
+    # all-gather for use (forward + recompute). Near-zero would mean
+    # the state silently replicated — the regression this file exists
+    # for (measured: 7; the replicated baseline below measures 1).
+    assert counts["all-gather"] >= 4, counts
+    # This layout has no ring axis: permutes are algorithmically
+    # impossible, so that zero IS exact. The all-to-alls are
+    # partitioner-chosen reshards (measured: 5) — not pinned.
     assert counts["collective-permute"] == 0, counts
-    assert counts["all-to-all"] == 5, counts
 
   def test_tp_mesh_adds_tensor_parallel_reductions(self):
+    fsdp = compile_qtopt_step(
+        ((DATA_AXIS, 4), (FSDP_AXIS, 2)), "fsdp")
     counts = compile_qtopt_step(
-        {DATA_AXIS: 2, FSDP_AXIS: 2, MODEL_AXIS: 2}, "tp")
+        ((DATA_AXIS, 2), (FSDP_AXIS, 2), (MODEL_AXIS, 2)), "tp")
     # Megatron-style partial-sum reductions of activations (forward
     # AND backward) on top of the gradient/metric reduces: strictly
-    # more all-reduces than the pure-fsdp layout.
-    assert counts["all-reduce"] == 15, counts
-    assert counts["all-gather"] == 41, counts
-    assert counts["all-to-all"] == 6, counts
+    # more all-reduces and param/activation gathers than the pure-fsdp
+    # layout (measured: 15 vs 9 reduces, 41 vs 7 gathers).
+    assert counts["all-reduce"] > fsdp["all-reduce"], (counts, fsdp)
+    assert counts["all-gather"] > fsdp["all-gather"], (counts, fsdp)
 
   def test_fsdp_vs_replicated_baseline(self):
     """Same step with NO state sharding: the param gathers disappear.
 
-    Proves the 7 all-gathers above are attributable to the fsdp rules
-    (one input gather remains here). The fused tuple gradient
-    all-reduce is still present — with replicated state the
-    partitioner still shards the batched compute over the mesh and
-    reduces gradients, it just never needs to gather parameters.
-    (Rounds 2–3 read this layout as "fully de-parallelized, zero
-    all-reduces"; that was the tuple-blind regex, not the program.)
+    Proves the fsdp all-gathers are attributable to the fsdp rules
+    (partitioner-chosen input reshard gathers remain here — measured:
+    1). The fused tuple gradient all-reduce is still present — with
+    replicated state the partitioner still shards the batched compute
+    over the mesh and reduces gradients, it just never needs to gather
+    parameters. (Rounds 2–3 read this layout as "fully
+    de-parallelized, zero all-reduces"; that was the tuple-blind
+    regex, not the program.)
     """
-    counts = compile_qtopt_step({DATA_AXIS: 4, FSDP_AXIS: 2},
+    fsdp = compile_qtopt_step(
+        ((DATA_AXIS, 4), (FSDP_AXIS, 2)), "fsdp")
+    counts = compile_qtopt_step(((DATA_AXIS, 4), (FSDP_AXIS, 2)),
                                 "replicated")
-    assert counts["all-reduce"] == 5, counts
-    assert counts["all-gather"] == 1, counts
+    assert counts["all-reduce"] >= 1, counts
+    assert counts["all-gather"] <= 2, counts
+    # The zero-style param gathers are the DIFFERENCE between the two
+    # layouts, whatever the combiner does within each.
+    assert fsdp["all-gather"] - counts["all-gather"] >= 3, (
+        fsdp, counts)
 
 
 class TestRingCollectives:
@@ -227,12 +246,14 @@ class TestMoECollectives:
     grad = jax.jit(jax.grad(loss))
     counts = collective_counts(
         grad.lower(variables["params"], x).compile().as_text())
-    # Forward's 2 + the transposed pair, with XLA's combiner merging
-    # one adjacent pair → 3. The aux pmean + its transpose + the
-    # router gradient reduction (router is replicated, its grad sums
-    # over every token group) account for the 3 all-reduces.
-    assert counts["all-to-all"] == 3, counts
-    assert counts["all-reduce"] == 3, counts
+    # Forward's dispatch/return pair + their transposes = 4, minus
+    # whatever adjacent pairs XLA's combiner merges (measured: 3).
+    # The algorithmic content is bounds: at least the forward pair
+    # survives, at most the un-merged 4. The all-reduces (aux pmean +
+    # transpose + router gradient reduction) are combiner-chosen;
+    # at least one must exist or the router gradient is lost.
+    assert 2 <= counts["all-to-all"] <= 4, counts
+    assert counts["all-reduce"] >= 1, counts
     assert counts["all-gather"] == 0, counts
 
 
@@ -278,11 +299,11 @@ class TestPipelineCollectives:
     counts = collective_counts(
         jax.jit(run).lower(params, x).compile().as_text())
     assert counts["collective-permute"] == 1, counts
-    # The single all-reduce is the last-stage output broadcast
-    # (psum over the stage ring); the all-gather reshards the
-    # stage-replicated input once on entry.
-    assert counts["all-reduce"] == 1, counts
-    assert counts["all-gather"] == 1, counts
+    # The last-stage output broadcast (an explicit psum over the stage
+    # ring) forces at least one all-reduce; the entry reshard gathers
+    # are partitioner-chosen (measured: 1 each).
+    assert counts["all-reduce"] >= 1, counts
+    assert counts["all-gather"] <= 2, counts
     assert counts["all-to-all"] == 0, counts
 
   def test_backward_adds_the_reverse_permute(self):

@@ -1,0 +1,218 @@
+"""Pipeline parallelism as a config-reachable framework capability.
+
+`parallel/pipeline.py`'s GPipe schedule got trunk integration in round
+5 (round-4 verdict: "a library primitive, not a framework capability"):
+`PipelinedCausalTransformer` stacks the trunk's blocks into stages
+under the ``stages`` param contract, `state_sharding` grew a
+"pipeline" strategy, and the vrgripper transformer family + a shipped
+.gin reach it by config. These tests pin that whole path:
+
+  * pipelined output/gradients == the sequential fallback on the SAME
+    stacked params (checkpoint portability: train on a pod, serve on
+    one chip),
+  * the "pipeline" sharding rules place stage-stacked leaves on
+    `stage` and raise rather than silently replicate,
+  * the shipped .gin trains end-to-end through `train_eval_model` on
+    a data×stage mesh and the checkpoint restores into a mesh-free
+    serving model.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from tensor2robot_tpu.layers.pipelined_transformer import (
+    PipelinedCausalTransformer,
+)
+from tensor2robot_tpu.parallel import (
+    DATA_AXIS,
+    STAGE_AXIS,
+    create_mesh,
+    pipeline_sharding,
+    state_sharding,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _trunk(mesh, **overrides):
+  kwargs = dict(width=32, depth=4, num_heads=2, max_len=16,
+                num_stages=4, num_microbatches=2, mesh=mesh,
+                dtype=jnp.float32)
+  kwargs.update(overrides)
+  return PipelinedCausalTransformer(**kwargs)
+
+
+class TestPipelinedTrunk:
+
+  @pytest.fixture(scope="class")
+  def mesh(self):
+    return create_mesh({DATA_AXIS: 2, STAGE_AXIS: 4})
+
+  def test_matches_sequential_fallback(self, mesh):
+    """Same stacked params, pipelined (data×stage mesh) vs the
+    sequential-scan fallback (mesh=None): identical outputs AND
+    parameter gradients — the portability contract that lets a
+    pod-trained pipelined checkpoint serve on one chip."""
+    x = jnp.asarray(
+        np.random.default_rng(0).standard_normal((8, 16, 8)),
+        jnp.float32)
+    pipelined = _trunk(mesh)
+    sequential = _trunk(None)
+    variables = sequential.init(jax.random.PRNGKey(0), x)
+
+    np.testing.assert_allclose(
+        np.asarray(pipelined.apply(variables, x)),
+        np.asarray(sequential.apply(variables, x)),
+        atol=1e-5, rtol=1e-5)
+
+    pp_grads = jax.grad(
+        lambda v: jnp.sum(pipelined.apply(v, x) ** 2))(variables)
+    seq_grads = jax.grad(
+        lambda v: jnp.sum(sequential.apply(v, x) ** 2))(variables)
+    flat_pp = jax.tree.leaves_with_path(pp_grads)
+    flat_seq = jax.tree.leaves(seq_grads)
+    assert flat_pp and len(flat_pp) == len(flat_seq)
+    for (path, pg), sg in zip(flat_pp, flat_seq):
+      np.testing.assert_allclose(
+          np.asarray(pg), np.asarray(sg), atol=5e-4, rtol=5e-4,
+          err_msg=str(path))
+
+  def test_remat_preserves_values(self, mesh):
+    x = jnp.asarray(
+        np.random.default_rng(1).standard_normal((8, 16, 8)),
+        jnp.float32)
+    plain = _trunk(mesh)
+    remat = _trunk(mesh, remat=True)
+    variables = plain.init(jax.random.PRNGKey(0), x)
+    np.testing.assert_allclose(
+        np.asarray(remat.apply(variables, x)),
+        np.asarray(plain.apply(variables, x)),
+        atol=1e-6, rtol=1e-6)
+
+  def test_depth_must_split_into_stages(self):
+    x = jnp.zeros((2, 8, 4), jnp.float32)
+    with pytest.raises(ValueError, match="num_stages"):
+      _trunk(None, depth=3).init(jax.random.PRNGKey(0), x)
+
+  def test_stage_params_carry_stage_dim(self):
+    x = jnp.zeros((2, 8, 4), jnp.float32)
+    variables = _trunk(None).init(jax.random.PRNGKey(0), x)
+    stages = variables["params"]["stages"]
+    for path, leaf in jax.tree.leaves_with_path(stages):
+      assert leaf.shape[0] == 4, (path, leaf.shape)
+
+
+class TestPipelineSharding:
+
+  def test_places_stage_leaves_on_stage_axis(self):
+    mesh = create_mesh({DATA_AXIS: 2, STAGE_AXIS: 4})
+    x = jnp.zeros((2, 8, 4), jnp.float32)
+    params = _trunk(None).init(jax.random.PRNGKey(0), x)["params"]
+    shardings = state_sharding(mesh, params, strategy="pipeline",
+                               min_size_to_shard=64)
+    for path, sh in jax.tree.leaves_with_path(shardings):
+      names = [str(getattr(k, "key", "")) for k in path]
+      if "stages" in names:
+        assert sh.spec == P(STAGE_AXIS), (path, sh)
+      else:
+        assert STAGE_AXIS not in jax.tree.leaves(
+            tuple(sh.spec)), (path, sh)
+
+  def test_indivisible_stage_dim_raises(self):
+    mesh = create_mesh({DATA_AXIS: 1, STAGE_AXIS: 8})
+    tree = {"stages": {"w": jnp.zeros((4, 16, 16))}}
+    with pytest.raises(ValueError, match="not divisible"):
+      pipeline_sharding(mesh, tree)
+
+
+class TestPipelinedBCByConfig:
+  """The shipped .gin trains the pipelined family end to end."""
+
+  @pytest.fixture(scope="class")
+  def run(self, tmp_path_factory):
+    from tensor2robot_tpu import config as gin
+    from tensor2robot_tpu import train_eval
+    import tensor2robot_tpu.research.vrgripper as vrgripper
+    import tensor2robot_tpu.data  # noqa: F401
+    import tensor2robot_tpu.parallel  # noqa: F401
+
+    root = tmp_path_factory.mktemp("pp_bc")
+    data = vrgripper.collect_demo_episodes(
+        str(root / "demos.tfrecord"), num_episodes=32, image_size=24,
+        seed=7, action_noise=0.1)
+    model_dir = str(root / "model")
+    path = os.path.join(
+        REPO, "tensor2robot_tpu", "research", "vrgripper", "configs",
+        "train_vrgripper_transformer_pipeline.gin")
+    gin.clear_config()
+    try:
+      gin.parse_config_files_and_bindings([path], [
+          f"train_eval_model.model_dir = '{model_dir}'",
+          "train_eval_model.max_train_steps = 6",
+          "train_eval_model.save_checkpoints_steps = 6",
+          "train_eval_model.log_every_steps = 2",
+          "train_eval_model.batch_size = 8",
+          f"train/TFRecordEpisodeInputGenerator.file_patterns = '{data}'",
+          "train/TFRecordEpisodeInputGenerator.sequence_length = 8",
+          "train/TFRecordEpisodeInputGenerator.batch_size = 8",
+          "VRGripperTransformerModel.image_size = 24",
+          "VRGripperTransformerModel.filters = (8,)",
+          "VRGripperTransformerModel.embedding_size = 16",
+          "VRGripperTransformerModel.width = 32",
+          "VRGripperTransformerModel.num_heads = 2",
+          "VRGripperTransformerModel.max_context_length = 64",
+      ])
+      model = gin.query_parameter("train_eval_model.model").resolve()
+      state = train_eval.train_eval_model()
+    finally:
+      gin.clear_config()
+    return model, model_dir, state
+
+  def test_trains_and_checkpoints_on_the_stage_mesh(self, run):
+    model, model_dir, state = run
+    records = [json.loads(line) for line in
+               open(os.path.join(model_dir, "metrics_train.jsonl"))]
+    assert records, "no train metrics written"
+    assert np.isfinite(records[-1]["loss"])
+    # The trunk actually trained stage-stacked and stage-sharded.
+    stages = state.params["trunk"]["stages"]
+    leaves = jax.tree.leaves(stages)
+    assert leaves and all(l.shape[0] == 4 for l in leaves)
+    assert any(
+        STAGE_AXIS in jax.tree.leaves(tuple(l.sharding.spec))
+        for l in leaves), "stage weights not sharded over `stage`"
+
+  def test_checkpoint_serves_on_mesh_free_model(self, run):
+    """Pod-trained pipelined checkpoint → single-chip serving model
+    (sequential fallback over the same stacked params)."""
+    from tensor2robot_tpu.research.vrgripper import (
+        VRGripperTransformerModel,
+    )
+    from tensor2robot_tpu.utils import checkpoints as ckpt_lib
+
+    _, model_dir, _ = run
+    serving = VRGripperTransformerModel(
+        image_size=24, filters=(8,), embedding_size=16, width=32,
+        depth=4, num_heads=2, max_context_length=64,
+        attention_impl="reference", pipeline_stages=4,
+        pipeline_microbatches=2, device_dtype=jnp.float32)
+    state = serving.create_inference_state(jax.random.PRNGKey(0))
+    variables = ckpt_lib.restore_variables(
+        model_dir, like={"params": state.params,
+                         "batch_stats": state.batch_stats or {}})
+    state = state.replace(params=variables["params"])
+    policy = serving.make_context_policy(state, context_length=8)
+    rng = np.random.default_rng(3)
+    out = policy({
+        "image": rng.integers(0, 255, (1, 24, 24, 3)).astype(np.uint8),
+        "gripper_pose": rng.standard_normal((1, 3)).astype(np.float32),
+    })
+    assert out["action"].shape == (1, 3)
+    assert np.isfinite(out["action"]).all()
