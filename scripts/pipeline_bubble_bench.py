@@ -17,9 +17,13 @@ sequential trunk executes M·S stage-microbatch units in total, so
                             gains over running the whole stack on one
                             device, compute-bound limit)
 
-The measured serialized ratio should approach (M+S-1)/M from above
-(ppermute/dispatch overhead rides on top); the gap IS the schedule
-overhead beyond the analytic bubble. Prints one JSON object on stdout.
+The measured serialized ratio should track (M+S-1)/M and shrink as M
+grows (bubble amortization). Measured (first committed run): 2.14 /
+1.52 / 1.25 at M=2/4/8 vs analytic 2.5 / 1.75 / 1.375 — slightly
+BELOW analytic because the sequential baseline pays its own scan
+overhead per stage while the pipeline's extra ticks are the cheapest
+kind (no ingest/collect work); the M-trend is the signal. Prints one
+JSON object on stdout.
 """
 
 import json

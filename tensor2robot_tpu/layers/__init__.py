@@ -37,3 +37,6 @@ from tensor2robot_tpu.layers.transformer import (
     MultiHeadAttention,
     TransformerBlock,
 )
+from tensor2robot_tpu.layers.pipelined_transformer import (
+    PipelinedCausalTransformer,
+)

@@ -99,6 +99,15 @@ class PipelinedCausalTransformer(nn.Module):
       raise ValueError(
           f"depth {self.depth} must split into num_stages="
           f"{self.num_stages} equal shape-preserving stages.")
+    if self.attention_impl in ("ring", "ring_flash"):
+      # The stage blocks run INSIDE the stage shard_map; composing a
+      # second (sequence-axis) shard_map per stage is not supported —
+      # without this guard the mesh silently isn't forwarded and
+      # _attend raises a misleading "pass mesh=" error.
+      raise ValueError(
+          "attention_impl='ring'/'ring_flash' (sequence parallelism) "
+          "cannot run inside pipeline stages; use 'flash', "
+          "'reference', or 'auto' for the pipelined trunk.")
     head_dim = self.width // self.num_heads
 
     x = nn.Dense(self.width, dtype=self.dtype, name="embed")(

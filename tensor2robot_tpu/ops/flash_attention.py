@@ -9,39 +9,41 @@ attention in O(T) memory: Q/K/V stream through VMEM in (block_q,
 block_k) tiles, scores live only in registers/VMEM, and the online
 softmax carries running max/normalizer/accumulator in f32 scratch.
 
-Measured on v5e at T=32768 causal (scan-amortized, D2H-barriered):
-28.9 TFLOP/s ≈ 15% of bf16 peak at D=64 in the committed round-4 run
-(session spread 24–29; see below for D=128) — where the materialized
-XLA attention OOMs beyond T≈4096. (Round 3 recorded 147 TFLOP/s for
-this kernel; that number does not reproduce under the hardened timing
-methodology and is retracted — see bench.py's docstring for why early
-numbers were tunnel artifacts.) The round-4 kernel is ~7× the honest
-round-3 baseline: large default blocks amortize Mosaic's
-sequential-grid per-step overhead, fully-masked causal K-blocks skip
-compute under pl.when, and the lse is stored as (8, block_q) tiles
-instead of a 128-lane broadcast (16× less lse HBM traffic). The
-remaining gap to peak is structural at D=64: the score/PV matmuls
-contract only 64 lanes of the 128-wide MXU, and the online-softmax VPU
-work (exp, max, rescale) is comparable to the matmul time at these
-tile shapes. That argument is confirmed empirically: the SAME kernel
-at D=128 (H halved, identical FLOPs) is consistently faster — 1.25×
-in the committed run (36.1 vs 28.9 TFLOP/s, `BENCH_DETAIL.json` →
-`long_context_d128` vs `long_context`), 1.8× in a quieter-tunnel
-session (43 vs 24). Models that care about attention throughput at
-long context should prefer MXU-width heads.
+Measured on v5e at T=32768 causal (scan-amortized, D2H-barriered),
+round-5 committed run: forward 32.6 TFLOP/s at D=64 / 46.7 at D=128
+(16.8 / 11.8 ms — `BENCH_DETAIL.json` → `long_context[_d128]`) —
+where the materialized XLA attention OOMs beyond T≈4096. (Round 3
+recorded 147 TFLOP/s for this kernel; that number does not reproduce
+under the hardened timing methodology and is retracted — see
+bench.py's docstring for why early numbers were tunnel artifacts;
+round 4's honest rebuild measured 24–36.) Round-5 gains came from a
+block sweep on hardware: (block_q, block_k) = (1024, 2048) default —
+fewer, larger grid steps amortize both Mosaic's per-step overhead and
+the online-softmax rescale chain. The remaining gap to peak is
+structural at D=64: the score/PV matmuls contract only 64 lanes of
+the 128-wide MXU, and the online-softmax VPU work (exp, max, rescale)
+is comparable to the matmul time at these tile shapes — confirmed
+empirically by the SAME kernel at D=128 (H halved, identical FLOPs)
+running consistently faster. Models that care about attention
+throughput at long context should prefer MXU-width heads.
 
 Training works end to end, and the backward is Pallas too (new in
-round 5; the round-4 backward was a scanned XLA program — the per-op
-profile showed it dominated by relayouts of the blockwise einsums):
-two kernels in the standard flash-backward formulation, each
-recomputing score tiles from q/k + the saved logsumexp —
-`_dkdv_kernel` accumulates dk/dv per K-block over the Q grid,
-`_dq_kernel` accumulates dq per Q-block over the K grid. The
-softmax-jacobian row term D_i = rowsum(dO·O) (minus any lse
-cotangent) is a cheap XLA elementwise reduce computed once outside.
-No [T, T] tensor exists in either direction; causal work-skipping
-applies to both directions (fully-masked tile pairs skip under
-pl.when).
+round 5; the round-4 backward was a scanned XLA program): two kernels
+in the standard flash-backward formulation, each recomputing score
+tiles from q/k + the saved logsumexp — `_dkdv_kernel` accumulates
+dk/dv per K-block over the Q grid, `_dq_kernel` accumulates dq per
+Q-block over the K grid. The softmax-jacobian row term
+D_i = rowsum(dO·O) (minus any lse cotangent) is a cheap XLA
+elementwise reduce computed once outside. No [T, T] tensor exists in
+either direction; causal work-skipping applies to both directions
+(fully-masked tile pairs skip under pl.when). Measured train step
+(fwd+bwd) at T=32k causal: 41.6 → 29.3 ms at D=64 (1.42×) and
+28.0 → 20.7 ms at D=128 (1.35×; 17.7 ms = 1.58× in a quieter-tunnel
+trial) vs the round-4 XLA backward — the backward portion alone
+dropped ~22.6 → ~12.5 ms, and the total is now FORWARD-bound (the
+backward kernels have no sequential max/rescale chain, so their five
+matmuls per tile pair run at higher MXU occupancy than the forward's
+two).
 
 Pairs with `parallel/ring_attention.py`: the ring shards the sequence
 ACROSS chips (ppermute over ICI), this kernel tiles it WITHIN a chip;
@@ -210,20 +212,22 @@ def _flash_forward_impl(q, k, v, causal: bool, block_q: int,
           lse[:, :, 0, :].reshape(b * h, t))
 
 
-def _transpose_tile(x):
-  """(1, n) → (n, 1) on the MXU (identity contraction).
+def _rows_to_col(x):
+  """(8, n) tile with identical rows → (n, 1) on the MXU.
 
-  The per-row lse/delta arrive as lane-major (1, block_q) tiles (the
-  dense storage layout) but broadcast against score tiles row-wise,
-  which needs the sublane-major (block_q, 1) layout; Mosaic cannot
-  reshape across the sublane/lane boundary, so transpose by
-  contracting against an identity — one (n×n)·(n×1) matmul, noise
-  next to the (bq×D)·(D×bk) score matmul.
+  The per-row lse/delta ride into the backward kernels in the SAME
+  (8, block_q) redundant-sublane tile layout the forward stores its
+  lse in (Mosaic block shapes need a sublane dim ≥ 8, and cannot
+  reshape across the sublane/lane boundary) — so the row values sit
+  on LANES but must broadcast against score tiles row-wise, which
+  needs the sublane-major (block_q, 1) layout. Contract the 8
+  redundant sublanes against a constant 1/8 column: one (n×8)·(8×1)
+  matmul, noise next to the (bq×D)·(D×bk) score matmul.
   """
-  n = x.shape[-1]
   return jax.lax.dot_general(
-      jnp.eye(n, dtype=jnp.float32), x.astype(jnp.float32),
-      (((1,), (1,)), ((), ())))
+      x.astype(jnp.float32),
+      jnp.full((8, 1), 0.125, jnp.float32),
+      (((0,), (0,)), ((), ())))
 
 
 def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -255,8 +259,8 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     k = k_ref[0]                                   # [bk, D]
     v = v_ref[0]
     do = do_ref[0]                                 # [bq, D]
-    lse = _transpose_tile(lse_ref[...])            # [bq, 1]
-    delta = _transpose_tile(delta_ref[...])        # [bq, 1]
+    lse = _rows_to_col(lse_ref[0, 0])              # [bq, 1]
+    delta = _rows_to_col(delta_ref[0, 0])          # [bq, 1]
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale  # [bq, bk]
@@ -318,8 +322,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     k = k_ref[0]
     v = v_ref[0]
     do = do_ref[0]
-    lse = _transpose_tile(lse_ref[...])
-    delta = _transpose_tile(delta_ref[...])
+    lse = _rows_to_col(lse_ref[0, 0])
+    delta = _rows_to_col(delta_ref[0, 0])
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale
@@ -366,12 +370,21 @@ def _flash_bwd_impl(q, k, v, out, lse, do, dlse, causal: bool,
 
   q_f, k_f, v_f, do_f, o_f = map(fold, (q, k, v, do, out))
   # δ_i = rowsum(dO·O) − dlse_i: the softmax-jacobian row term, a
-  # cheap elementwise reduce XLA fuses; both kernels read it as dense
-  # (1, block_q) lane tiles alongside the lse.
+  # cheap elementwise reduce XLA fuses. Both per-row vectors enter
+  # the kernels in the forward's (8, block_q) redundant-sublane tile
+  # layout (Mosaic block sublane dims must be ≥ 8; the 8× redundancy
+  # is ~T×32 bytes per head — noise next to the q/k/v streams).
   delta = (jnp.sum(do_f.astype(jnp.float32) * o_f.astype(jnp.float32),
                    axis=-1)
            - dlse.astype(jnp.float32))              # [BH, T]
-  lse = lse.astype(jnp.float32)
+
+  def tile_rows(x):  # [BH, T] → [BH, nq, 8, block_q]
+    return jnp.broadcast_to(
+        x.astype(jnp.float32).reshape(b * h, nq, 1, block_q),
+        (b * h, nq, 8, block_q))
+
+  lse = tile_rows(lse)
+  delta = tile_rows(delta)
 
   dk_f, dv_f = pl.pallas_call(
       functools.partial(_dkdv_kernel, scale=scale, causal=causal,
@@ -383,8 +396,10 @@ def _flash_bwd_impl(q, k, v, out, lse, do, dlse, causal: bool,
           pl.BlockSpec((1, block_k, d), lambda g, j, i: (g, j, 0)),
           pl.BlockSpec((1, block_k, d), lambda g, j, i: (g, j, 0)),
           pl.BlockSpec((1, block_q, d), lambda g, j, i: (g, i, 0)),
-          pl.BlockSpec((1, block_q), lambda g, j, i: (g, i)),
-          pl.BlockSpec((1, block_q), lambda g, j, i: (g, i)),
+          pl.BlockSpec((1, 1, 8, block_q),
+                       lambda g, j, i: (g, i, 0, 0)),
+          pl.BlockSpec((1, 1, 8, block_q),
+                       lambda g, j, i: (g, i, 0, 0)),
       ],
       out_specs=[
           pl.BlockSpec((1, block_k, d), lambda g, j, i: (g, j, 0)),
@@ -411,8 +426,10 @@ def _flash_bwd_impl(q, k, v, out, lse, do, dlse, causal: bool,
           pl.BlockSpec((1, block_k, d), lambda g, i, j: (g, j, 0)),
           pl.BlockSpec((1, block_k, d), lambda g, i, j: (g, j, 0)),
           pl.BlockSpec((1, block_q, d), lambda g, i, j: (g, i, 0)),
-          pl.BlockSpec((1, block_q), lambda g, i, j: (g, i)),
-          pl.BlockSpec((1, block_q), lambda g, i, j: (g, i)),
+          pl.BlockSpec((1, 1, 8, block_q),
+                       lambda g, i, j: (g, i, 0, 0)),
+          pl.BlockSpec((1, 1, 8, block_q),
+                       lambda g, i, j: (g, i, 0, 0)),
       ],
       out_specs=[
           pl.BlockSpec((1, block_q, d), lambda g, i, j: (g, i, 0)),
@@ -459,8 +476,8 @@ def flash_attention_with_lse(
     k: jax.Array,
     v: jax.Array,
     causal: bool = False,
-    block_q: int = 512,
-    block_k: int = 1024,
+    block_q: int = 1024,
+    block_k: int = 2048,
     interpret: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
   """Like `flash_attention` but also returns the logsumexp.
@@ -489,8 +506,8 @@ def flash_attention(
     k: jax.Array,
     v: jax.Array,
     causal: bool = False,
-    block_q: int = 512,
-    block_k: int = 1024,
+    block_q: int = 1024,
+    block_k: int = 2048,
     interpret: bool = False,
 ) -> jax.Array:
   """Exact attention, O(T) memory both ways. [B, T, H, D] → same.

@@ -32,6 +32,11 @@ from tensor2robot_tpu.parallel.mesh import (
 )
 
 
+def _path_key_name(key) -> str:
+  """The string name of a pytree path entry (DictKey or GetAttrKey)."""
+  return str(getattr(key, "key", getattr(key, "name", "")))
+
+
 def fsdp_sharding(
     mesh: Mesh,
     tree: Any,
@@ -112,13 +117,12 @@ def expert_sharding(mesh: Mesh, tree: Any,
     return fsdp_sharding(mesh, tree, min_size_to_shard)
   size = mesh.shape[EXPERT_AXIS]
 
-  def _name(key) -> str:
-    return str(getattr(key, "key", getattr(key, "name", "")))
-
   def rule(path, leaf):
     shape = getattr(leaf, "shape", ())
-    is_expert = (path and _name(path[-1]).startswith("expert_")
-                 and (len(path) == 1 or _name(path[-2]) == "moe"))
+    is_expert = (path
+                 and _path_key_name(path[-1]).startswith("expert_")
+                 and (len(path) == 1
+                      or _path_key_name(path[-2]) == "moe"))
     if is_expert:
       if not shape or shape[0] % size != 0:
         raise ValueError(
@@ -150,12 +154,9 @@ def pipeline_sharding(mesh: Mesh, tree: Any,
     return fsdp_sharding(mesh, tree, min_size_to_shard)
   size = mesh.shape[STAGE_AXIS]
 
-  def _name(key) -> str:
-    return str(getattr(key, "key", getattr(key, "name", "")))
-
   def rule(path, leaf):
     shape = getattr(leaf, "shape", ())
-    if any(_name(key) == "stages" for key in path):
+    if any(_path_key_name(key) == "stages" for key in path):
       if not shape or shape[0] % size != 0:
         raise ValueError(
             f"stage-stacked weight {jax.tree_util.keystr(path)} has "

@@ -101,6 +101,15 @@ class TestPipelinedTrunk:
     with pytest.raises(ValueError, match="num_stages"):
       _trunk(None, depth=3).init(jax.random.PRNGKey(0), x)
 
+  def test_ring_attention_inside_stages_rejected(self):
+    """Sequence parallelism can't nest inside the stage shard_map;
+    the guard must name the real constraint (without it the mesh is
+    silently dropped and _attend raises a misleading error)."""
+    x = jnp.zeros((8, 8, 4), jnp.float32)
+    with pytest.raises(ValueError, match="pipeline stages"):
+      _trunk(None, attention_impl="ring_flash").init(
+          jax.random.PRNGKey(0), x)
+
   def test_stage_params_carry_stage_dim(self):
     x = jnp.zeros((2, 8, 4), jnp.float32)
     variables = _trunk(None).init(jax.random.PRNGKey(0), x)

@@ -1,0 +1,116 @@
+"""Unit tests for the sharding-rule contracts tightened in round 5.
+
+The advisor flagged `expert_sharding`'s name matching as too loose
+(any path segment starting with ``expert_``) and its indivisible-dim
+fallback as silent; the rule now requires the MoEMLP placement
+contract (an ``expert_*`` leaf directly under a ``moe`` module, or at
+the tree root for a bare MoEMLP tree) and raises on indivisibility.
+`xplane.is_async_window` (the compute-table filter behind the bench's
+per-op attribution) gets direct unit coverage too.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from tensor2robot_tpu.parallel import (
+    DATA_AXIS,
+    EXPERT_AXIS,
+    create_mesh,
+    expert_sharding,
+)
+from tensor2robot_tpu.utils import xplane
+
+
+class TestExpertShardingScope:
+
+  @pytest.fixture()
+  def mesh(self):
+    return create_mesh({DATA_AXIS: 2, EXPERT_AXIS: 4})
+
+  def test_expert_leaf_under_moe_shards_on_expert(self, mesh):
+    tree = {"block1": {"moe": {"expert_w_in": jnp.zeros((8, 16, 32))}}}
+    sh = expert_sharding(mesh, tree, min_size_to_shard=64)
+    assert sh["block1"]["moe"]["expert_w_in"].spec == P(EXPERT_AXIS)
+
+  def test_root_level_expert_leaf_shards(self, mesh):
+    """A bare MoEMLP param tree has expert leaves at the root."""
+    sh = expert_sharding(mesh, {"expert_w_in": jnp.zeros((8, 16, 32))},
+                         min_size_to_shard=64)
+    assert sh["expert_w_in"].spec == P(EXPERT_AXIS)
+
+  def test_optimizer_mirror_path_shards_too(self, mesh):
+    """Adam moments nest the param path under opt-state prefixes; the
+    (parent == moe) scope must still match."""
+    tree = {"mu": {"trunk": {"moe": {
+        "expert_w_out": jnp.zeros((8, 32, 16))}}}}
+    sh = expert_sharding(mesh, tree, min_size_to_shard=64)
+    assert sh["mu"]["trunk"]["moe"]["expert_w_out"].spec == P(
+        EXPERT_AXIS)
+
+  def test_unrelated_expert_prefixed_leaf_uses_fsdp_rules(self, mesh):
+    """The advisor's collision case: an `expert_`-prefixed param NOT
+    under a moe module (here under an unrelated module) must follow
+    the fsdp rules — with no fsdp axis in this mesh, replicate —
+    instead of silently landing on the expert axis."""
+    tree = {"policy": {"expert_demo_encoder": jnp.zeros((8, 64, 64))}}
+    sh = expert_sharding(mesh, tree, min_size_to_shard=64)
+    spec = sh["policy"]["expert_demo_encoder"].spec
+    assert EXPERT_AXIS not in [ax for ax in spec if ax], spec
+
+  def test_indivisible_expert_dim_raises(self, mesh):
+    tree = {"moe": {"expert_w_in": jnp.zeros((6, 16, 32))}}
+    with pytest.raises(ValueError, match="not divisible"):
+      expert_sharding(mesh, tree, min_size_to_shard=64)
+
+  def test_no_expert_axis_falls_back_to_fsdp(self):
+    mesh = create_mesh({DATA_AXIS: 8})
+    tree = {"moe": {"expert_w_in": jnp.zeros((6, 16, 32))}}
+    # No expert axis: the indivisible dim is irrelevant; fsdp rules
+    # (here: replicated) apply without raising.
+    sh = expert_sharding(mesh, tree, min_size_to_shard=64)
+    assert sh["moe"]["expert_w_in"].spec == P()
+
+
+class TestAsyncWindowFilter:
+  """The per-op compute filter: -start/-done spans are wall windows
+  overlapping compute (round-4's committed tables were 10/10
+  copy-starts), so they must be excluded from busy-time attribution
+  — and ONLY they."""
+
+  @pytest.mark.parametrize("name", [
+      "%copy-start.113 = (f32[64]...) copy-start(...)",
+      "%copy-done.77 = f32[64] copy-done(...)",
+      "%all-gather-start.3 = ...",
+      "%all-reduce-done.9 = ...",
+      "%collective-permute-start.1 = ...",
+  ])
+  def test_async_windows_match(self, name):
+    assert xplane.is_async_window(name)
+
+  @pytest.mark.parametrize("name", [
+      "%fusion.481 = bf16[256,16,16,64] fusion(...)",
+      "%convert_reduce_fusion.27 = f32[16384,64] fusion(...)",
+      "%convolution.12 = ...",
+      "%all-reduce.4 = ...",          # sync collective: busy time
+      "%custom-call.5 = ...",
+      "%multiply_add_fusion.153 = ...",
+  ])
+  def test_compute_ops_pass(self, name):
+    assert not xplane.is_async_window(name)
+
+  def test_top_ops_compute_only_drops_windows(self, tmp_path,
+                                              monkeypatch):
+    monkeypatch.setattr(
+        xplane, "op_times_ms",
+        lambda trace_dir, plane_filter="TPU": {
+            "%copy-start.1": 75.0,
+            "%fusion.2": 50.0,
+            "%while": 400.0,
+            "%convolution.3": 25.0,
+        })
+    got = xplane.top_ops("unused", k=10, hlo_only=True,
+                         compute_only=True)
+    assert got == [("%fusion.2", 50.0), ("%convolution.3", 25.0)]

@@ -487,3 +487,50 @@ class TestShippedConfig:
           Mode.TRAIN).image.is_sequence
     finally:
       gin.clear_config()
+
+
+class TestAuxLossKeyReservation:
+  """'aux_loss' is reserved for the network-sown auxiliary loss: a
+  subclass scalar/metric of the same name raised silently-overwritten
+  metrics until round 5; now it's a loud ValueError (advisor
+  finding)."""
+
+  def _batch(self, t=8):
+    rng = np.random.default_rng(2)
+    feats = TensorSpecStruct.from_flat_dict({
+        "image": rng.integers(0, 255, (2, t, IMG, IMG, 3)
+                              ).astype(np.uint8),
+        "gripper_pose": rng.standard_normal((2, t, 3)
+                                            ).astype(np.float32),
+    })
+    labels = TensorSpecStruct.from_flat_dict({
+        "action": rng.standard_normal((2, t, 3)).astype(np.float32)})
+    return feats, labels
+
+  def test_train_scalar_collision_raises(self):
+    model = tiny_model(moe_experts=2, moe_every=1)
+    orig = model.model_train_fn
+
+    def clashing(features, labels, outputs, mode):
+      loss, scalars = orig(features, labels, outputs, mode)
+      return loss, {**scalars, "aux_loss": jnp.zeros(())}
+
+    model.model_train_fn = clashing
+    state = model.create_train_state(jax.random.PRNGKey(0))
+    feats, labels = self._batch()
+    with pytest.raises(ValueError, match="reserved"):
+      model.train_step(state, feats, labels, jax.random.PRNGKey(1))
+
+  def test_eval_metric_collision_raises(self):
+    model = tiny_model(moe_experts=2, moe_every=1)
+    orig = model.model_eval_fn
+
+    def clashing(features, labels, outputs):
+      return {**orig(features, labels, outputs),
+              "aux_loss": jnp.zeros(())}
+
+    model.model_eval_fn = clashing
+    state = model.create_train_state(jax.random.PRNGKey(0))
+    feats, labels = self._batch()
+    with pytest.raises(ValueError, match="reserved"):
+      model.eval_step(state, feats, labels)
