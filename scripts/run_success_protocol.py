@@ -149,7 +149,11 @@ def run_qtopt_online(tmp: str) -> None:
                  "seed": 5, "cem_population": 64, "cem_iterations": 3}
   hook = QTOptSuccessEvalHook(learner, eval_kwargs=eval_kwargs)
 
-  # --- Phase 1: offline-only pretrain. ---
+  # --- Phase 1: offline-only pretrain. steps_per_dispatch=50 is the
+  # iterations_per_loop lever: through a degraded tunnel, per-step
+  # dispatch crawls at a few steps/s while the chip itself runs
+  # hundreds — 50 steps per device program makes the protocol run
+  # dispatch-latency-proof (identical numerics, tested). ---
   offline_steps = 2000
   state = train_qtopt(
       learner=learner,
@@ -159,6 +163,7 @@ def run_qtopt_online(tmp: str) -> None:
       batch_size=256,
       save_checkpoints_steps=500,
       log_every_steps=250,
+      steps_per_dispatch=50,
       hooks=[hook],
   )
 
@@ -186,6 +191,7 @@ def run_qtopt_online(tmp: str) -> None:
       batch_size=256,
       save_checkpoints_steps=500,
       log_every_steps=250,
+      steps_per_dispatch=50,
       hooks=[QTOptSuccessEvalHook(ft_learner, eval_kwargs=eval_kwargs),
              ActorStateRefreshHook([actor])],
   )

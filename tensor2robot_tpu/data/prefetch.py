@@ -30,6 +30,58 @@ def make_data_sharding(mesh: jax.sharding.Mesh,
   return jax.sharding.NamedSharding(mesh, spec)
 
 
+def validate_steps_per_dispatch(k: int, **cadences: Optional[int]
+                                ) -> int:
+  """Checks the iterations_per_loop quantization contract.
+
+  Every named cadence (log/checkpoint/eval/max-steps) must be a
+  multiple of K — boundaries are only observable between dispatches.
+  Shared by both trainers so the contract cannot silently diverge.
+  Returns k. None-valued cadences are skipped.
+  """
+  k = int(k)
+  if k < 1:
+    raise ValueError(f"steps_per_dispatch must be >= 1, got {k}")
+  if k > 1:
+    for name, value in cadences.items():
+      if value and value % k:
+        raise ValueError(
+            f"{name}={value} must be a multiple of "
+            f"steps_per_dispatch={k} (the iterations_per_loop "
+            "quantization: boundaries are only observable between "
+            "dispatches).")
+  return k
+
+
+def stack_batches(stream: Iterator[Any], k: int) -> Iterator[Any]:
+  """Groups K consecutive batches into one [K, B, ...]-stacked pytree.
+
+  The host side of `steps_per_dispatch`: the trainer's scan consumes
+  one stacked block per device program. A finite stream that runs dry
+  mid-stack ends the output stream cleanly (the partial stack is
+  dropped — PEP 479 would otherwise turn the inner StopIteration into
+  a RuntimeError and crash the run past its final checkpoint).
+  """
+  it = iter(stream)
+  while True:
+    batches = []
+    for _ in range(k):
+      try:
+        batches.append(next(it))
+      except StopIteration:
+        return
+    yield jax.tree_util.tree_map(
+        lambda *xs: np.stack(xs), *batches)
+
+
+def stacked_sharding(sharding: jax.sharding.NamedSharding
+                     ) -> jax.sharding.NamedSharding:
+  """The [K, B, ...]-stacked twin of a batch sharding: the batch dim's
+  spec shifts right one position (K is never sharded)."""
+  return jax.sharding.NamedSharding(
+      sharding.mesh, jax.sharding.PartitionSpec(None, *sharding.spec))
+
+
 def device_put_batch(batch: Any, sharding: jax.sharding.Sharding) -> Any:
   """Places a pytree of host numpy arrays as global sharded jax.Arrays."""
 

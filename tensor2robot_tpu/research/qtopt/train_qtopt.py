@@ -48,15 +48,36 @@ def train_qtopt(
     hooks: Iterable[Hook] = (),
     seed: int = 0,
     prefill_random: bool = False,
+    steps_per_dispatch: int = 1,
 ) -> QTOptState:
   """Runs the QT-Opt learner loop; resumes from model_dir checkpoints.
 
   `replay_buffer` must be fed by actors (or pre-filled from logged
   episodes); `prefill_random=True` fills it with spec-random
   transitions instead (benchmarks / smoke tests).
+
+  `steps_per_dispatch` (K) is the reference TPUEstimator's
+  `iterations_per_loop` (SURVEY.md §4.1: "the hot loop"): K train
+  steps run as ONE device program per host call — a `lax.scan` over K
+  host-stacked replay batches — so host/dispatch latency is paid once
+  per K steps instead of every step (on a tunneled or remote-host
+  chip, per-step dispatch caps throughput an order of magnitude below
+  the chip's measured rate). The reference's quantization semantics
+  apply: every cadence (log, checkpoint, max steps) must be a
+  multiple of K, per-step hooks observe only each dispatch's LAST
+  metrics, and the per-step PRNG stream is identical to K=1 (folded
+  by absolute step inside the scan).
   """
   if mesh is None:
     mesh = mesh_lib.create_mesh()
+  # Validate the dispatch quantization BEFORE any side effects
+  # (hook begin() starts actor threads; a late ValueError would leak
+  # them past their teardown owner, the loop's try/finally).
+  k = prefetch_lib.validate_steps_per_dispatch(
+      steps_per_dispatch,
+      log_every_steps=log_every_steps,
+      save_checkpoints_steps=save_checkpoints_steps,
+      max_train_steps=max_train_steps)
   os.makedirs(model_dir, exist_ok=True)
   metric_logger = MetricLogger(model_dir)
   hook_list = HookList(list(hooks))
@@ -69,12 +90,6 @@ def train_qtopt(
         batch_size=min(replay_buffer.capacity, 4 * batch_size),
         seed=seed)
     replay_buffer.add(fill)
-  # Hooks begin BEFORE the replay wait: an ActorStateRefreshHook whose
-  # actors bootstrap an empty buffer must start collecting now, or
-  # this wait would deadlock.
-  hook_list.begin(learner.model, model_dir)
-  replay_buffer.wait_until_size(min_replay_size or batch_size)
-
   rng = jax.random.PRNGKey(seed)
   state = learner.create_state(rng, batch_size=2)
   repl = mesh_lib.replicated(mesh)
@@ -86,18 +101,63 @@ def train_qtopt(
     state = ckpt_lib.restore_state(model_dir, like=state,
                                    step=resume_step)
 
+  # Resume-alignment check BEFORE hooks begin (actor threads) and
+  # before the prefetcher exists: raising later would leak both past
+  # their teardown owner (the loop's try/finally).
+  step = int(np.asarray(jax.device_get(state.step)))
+  if k > 1 and step % k:
+    metric_logger.close()
+    raise ValueError(
+        f"Resumed at step {step}, not a multiple of "
+        f"steps_per_dispatch={k}: the checkpoint/log boundaries "
+        "would never align. Resume with K=1 (or a K dividing the "
+        "resume step) first.")
+
+  # Hooks begin BEFORE the replay wait: an ActorStateRefreshHook whose
+  # actors bootstrap an empty buffer must start collecting now, or
+  # this wait would deadlock.
+  hook_list.begin(learner.model, model_dir)
+  replay_buffer.wait_until_size(min_replay_size or batch_size)
+
   writer = ckpt_lib.CheckpointWriter(
       model_dir, max_to_keep=max_checkpoints_to_keep)
-  train_step = jax.jit(
-      learner.train_step,
-      in_shardings=(repl, data_sharding, repl),
-      out_shardings=(repl, repl),
-      donate_argnums=(0,),
-  )
+
+  if k == 1:
+    train_step = jax.jit(
+        learner.train_step,
+        in_shardings=(repl, data_sharding, repl),
+        out_shardings=(repl, repl),
+        donate_argnums=(0,),
+    )
+    stream = replay_buffer.as_stream(batch_size)
+    stream_sharding = data_sharding
+  else:
+    from jax import numpy as jnp
+
+    def k_steps(st, stacked, rng, step0):
+      def body(carry, xs):
+        st, i = carry
+        st, metrics = learner.train_step(
+            st, xs, jax.random.fold_in(rng, step0 + i))
+        return (st, i + 1), metrics
+      (st, _), metrics_seq = jax.lax.scan(
+          body, (st, jnp.zeros((), jnp.int32)), stacked)
+      # Hooks/logging observe the dispatch's LAST step only.
+      return st, jax.tree_util.tree_map(lambda m: m[-1], metrics_seq)
+
+    stacked_sharding = prefetch_lib.stacked_sharding(data_sharding)
+    train_step = jax.jit(
+        k_steps,
+        in_shardings=(repl, stacked_sharding, repl, repl),
+        out_shardings=(repl, repl),
+        donate_argnums=(0,),
+    )
+    stream = prefetch_lib.stack_batches(
+        replay_buffer.as_stream(batch_size), k)
+    stream_sharding = stacked_sharding
 
   prefetcher = prefetch_lib.ShardedPrefetcher(
-      replay_buffer.as_stream(batch_size), data_sharding, buffer_size=2)
-  step = int(np.asarray(jax.device_get(state.step)))
+      stream, stream_sharding, buffer_size=2)
   step_rng = jax.random.PRNGKey(seed + 1)
   t_last = time.time()
   steps_since_log = 0
@@ -106,10 +166,16 @@ def train_qtopt(
     for transitions in prefetcher:
       if step >= max_train_steps:
         break
-      state, metrics = train_step(state, transitions,
-                                  jax.random.fold_in(step_rng, step))
-      step += 1
-      steps_since_log += 1
+      if k == 1:
+        state, metrics = train_step(
+            state, transitions, jax.random.fold_in(step_rng, step))
+      else:
+        # Same per-step PRNG stream as K=1: the scan body folds
+        # step_rng by ABSOLUTE step (step0 + i).
+        state, metrics = train_step(state, transitions, step_rng,
+                                    np.int32(step))
+      step += k
+      steps_since_log += k
       hook_list.after_step(step, metrics)
       if step % log_every_steps == 0 or step == max_train_steps:
         scalars = jax.device_get(metrics)

@@ -144,6 +144,7 @@ def train_eval_model(
     log_every_steps: int = 100,
     seed: int = 0,
     init_batch_size: int = 2,
+    steps_per_dispatch: int = 1,
 ):
   """Trains (with interleaved eval) and exports; resumes automatically.
 
@@ -151,14 +152,31 @@ def train_eval_model(
   (`parallel.state_sharding` rules): "replicated" (pure data
   parallelism, the default), "fsdp" (zero-style param/optimizer
   sharding over the `fsdp` axis), "tp" (megatron-style over `model`),
-  or "ep" (stacked expert weights over `expert` — MoE models). The
-  batch always shards over the data-like axes; GSPMD inserts the
-  collectives each layout needs.
+  "ep" (stacked expert weights over `expert` — MoE models), or
+  "pipeline" (stage-stacked weights over `stage`). The batch always
+  shards over the data-like axes; GSPMD inserts the collectives each
+  layout needs.
+
+  `steps_per_dispatch` (K) is the reference TPUEstimator's
+  `iterations_per_loop` (SURVEY.md §4.1): K train steps run as ONE
+  device program per host call — a `lax.scan` over K host-stacked
+  input batches — paying host/dispatch latency once per K steps.
+  Quantization semantics: log/checkpoint/eval cadences and
+  max_train_steps must be multiples of K, and per-step hooks observe
+  each dispatch's LAST metrics. The per-step PRNG stream is identical
+  to K=1.
 
   Returns the final TrainState (on device, placed per the strategy).
   """
   if mesh is None:
     mesh = mesh_lib.create_mesh()
+  # Validate the dispatch quantization BEFORE any side effects.
+  k = prefetch_lib.validate_steps_per_dispatch(
+      steps_per_dispatch,
+      log_every_steps=log_every_steps,
+      save_checkpoints_steps=save_checkpoints_steps,
+      max_train_steps=max_train_steps,
+      eval_every_steps=eval_every_steps)
   os.makedirs(model_dir, exist_ok=True)
   metric_logger = MetricLogger(model_dir)
   hook_list = HookList(list(hooks))
@@ -189,17 +207,59 @@ def train_eval_model(
       model_dir, max_to_keep=max_checkpoints_to_keep)
   train_step, eval_step = _compile_steps(
       model, mesh, state_shardings=state_shardings)
+
+  if k > 1:
+    repl = mesh_lib.replicated(mesh)
+    stacked_sh = prefetch_lib.stacked_sharding(
+        mesh_lib.batch_sharding(mesh))
+
+    def k_steps(st, stacked_features, stacked_labels, rng, step0):
+      def body(carry, xs):
+        st, i = carry
+        features, labels = xs
+        st, metrics = model.train_step(
+            st, features, labels, jax.random.fold_in(rng, step0 + i))
+        return (st, i + 1), metrics
+      (st, _), metrics_seq = jax.lax.scan(
+          body, (st, jnp.zeros((), jnp.int32)),
+          (stacked_features, stacked_labels))
+      return st, jax.tree_util.tree_map(lambda m: m[-1], metrics_seq)
+
+    train_step = jax.jit(
+        k_steps,
+        in_shardings=(state_shardings, stacked_sh, stacked_sh,
+                      repl, repl),
+        out_shardings=(state_shardings, repl),
+        donate_argnums=(0,),
+    )
+  # Resume-alignment check BEFORE hooks begin: raising later would
+  # leak whatever begin() started past hook_list.end().
+  step = int(np.asarray(jax.device_get(state.step)))
+  if k > 1 and step % k and step < max_train_steps:
+    writer.close()
+    metric_logger.close()
+    raise ValueError(
+        f"Resumed at step {step}, not a multiple of "
+        f"steps_per_dispatch={k}: boundaries would never align.")
   hook_list.begin(model, model_dir)
 
-  step = int(np.asarray(jax.device_get(state.step)))
   final_metrics: Dict[str, Any] = {}
   train_prefetcher = None
   try:
     if input_generator_train is not None and step < max_train_steps:
       stream = input_generator_train.create_dataset(
           Mode.TRAIN, batch_size=batch_size)
+      if k > 1:
+        # Finite streams end cleanly mid-stack (the shared helper
+        # swallows the inner StopIteration PEP 479 would otherwise
+        # convert to a RuntimeError, preserving the final
+        # off-interval checkpoint below).
+        stream = prefetch_lib.stack_batches(stream, k)
+        feed_sharding = stacked_sh
+      else:
+        feed_sharding = mesh_lib.batch_sharding(mesh)
       prefetcher = train_prefetcher = prefetch_lib.ShardedPrefetcher(
-          stream, mesh_lib.batch_sharding(mesh), buffer_size=2)
+          stream, feed_sharding, buffer_size=2)
       step_rng = jax.random.PRNGKey(seed + 1)
       t_last = time.time()
       steps_since_log = 0
@@ -207,10 +267,15 @@ def train_eval_model(
       for features, labels in prefetcher:
         if step >= max_train_steps:
           break
-        state, metrics = train_step(
-            state, features, labels, jax.random.fold_in(step_rng, step))
-        step += 1
-        steps_since_log += 1
+        if k == 1:
+          state, metrics = train_step(
+              state, features, labels,
+              jax.random.fold_in(step_rng, step))
+        else:
+          state, metrics = train_step(state, features, labels,
+                                      step_rng, np.int32(step))
+        step += k
+        steps_since_log += k
         hook_list.after_step(step, metrics)
 
         if step % log_every_steps == 0 or step == max_train_steps:

@@ -117,14 +117,20 @@ class ProfilerHook(Hook):
     self._num = num_steps
     self._logdir = logdir
     self._cm: Optional[Any] = None
+    self._opened = False
     self._block_on: Optional[Callable] = None
 
   def begin(self, model, model_dir: str) -> None:
     if self._logdir is None:
       self._logdir = os.path.join(model_dir, "profile")
+    self._opened = False
 
   def after_step(self, step: int, metrics: dict) -> None:
-    if self._cm is None and step == self._start:
+    # `>=` + the opened flag, not `==`: under steps_per_dispatch > 1
+    # hooks only observe every K-th step, so an exact-match trigger
+    # would silently never fire when start_step isn't a multiple of K.
+    if self._cm is None and not self._opened and step >= self._start:
+      self._opened = True
       self._cm = trace(self._logdir)
       self._cm.__enter__()
     elif self._cm is not None and step >= self._start + self._num:

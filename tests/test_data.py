@@ -688,3 +688,26 @@ class TestPrefetch:
     next(iter(prefetcher))
     prefetcher.close()
     assert not prefetcher._thread.is_alive()
+
+
+class TestStackBatches:
+  """The steps_per_dispatch host-side stacker (data/prefetch.py)."""
+
+  def test_groups_k_batches(self):
+    from tensor2robot_tpu.data.prefetch import stack_batches
+
+    stream = ({"x": np.full((2, 3), i, np.float32)} for i in range(6))
+    stacks = list(stack_batches(stream, 3))
+    assert len(stacks) == 2
+    assert stacks[0]["x"].shape == (3, 2, 3)
+    np.testing.assert_array_equal(stacks[1]["x"][:, 0, 0], [3, 4, 5])
+
+  def test_finite_stream_ends_cleanly_mid_stack(self):
+    """PEP 479 guard: the inner StopIteration must NOT surface as a
+    RuntimeError — a finite input stream ends the run cleanly (the
+    trainer's final off-interval checkpoint depends on it)."""
+    from tensor2robot_tpu.data.prefetch import stack_batches
+
+    stream = ({"x": np.zeros((2,), np.float32)} for _ in range(5))
+    stacks = list(stack_batches(stream, 2))  # 5 = 2 stacks + 1 dropped
+    assert len(stacks) == 2

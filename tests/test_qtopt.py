@@ -476,3 +476,59 @@ class TestTrainQTOpt:
         prefill_random=True,
     )
     assert int(np.asarray(jax.device_get(state2.step))) == 4
+
+  def test_steps_per_dispatch_matches_per_step_training(self, tmp_path):
+    """K-scanned dispatches (`iterations_per_loop` semantics) must be
+    numerically identical to per-step dispatch: same replay stream
+    (same buffer seed), same per-step PRNG folding, so the final
+    params and step count agree exactly."""
+    from tensor2robot_tpu.research.qtopt import ReplayBuffer
+    from tensor2robot_tpu.specs import make_random_tensors
+
+    def run(k, name):
+      model = _tiny_model()
+      learner = QTOptLearner(model, cem_population=8,
+                             cem_iterations=1, cem_elites=2)
+      replay = ReplayBuffer(learner.transition_specification(),
+                            capacity=64, seed=7)
+      replay.add(make_random_tensors(
+          learner.transition_specification(), batch_size=64, seed=3))
+      return train_qtopt(
+          learner=learner,
+          model_dir=str(tmp_path / name),
+          replay_buffer=replay,
+          max_train_steps=6,
+          batch_size=8,
+          save_checkpoints_steps=6,
+          log_every_steps=3,
+          steps_per_dispatch=k,
+      )
+
+    base = run(1, "k1")
+    scanned = run(3, "k3")
+    assert int(np.asarray(jax.device_get(scanned.step))) == 6
+    for (path, a), b in zip(
+        jax.tree_util.tree_leaves_with_path(
+            jax.device_get(base.train_state.params)),
+        jax.tree_util.tree_leaves(
+            jax.device_get(scanned.train_state.params))):
+      np.testing.assert_allclose(
+          np.asarray(a), np.asarray(b), atol=1e-6, rtol=1e-6,
+          err_msg=str(path))
+
+  def test_steps_per_dispatch_rejects_misaligned_cadence(self,
+                                                         tmp_path):
+    model = _tiny_model()
+    learner = QTOptLearner(model, cem_population=8, cem_iterations=1,
+                           cem_elites=2)
+    with pytest.raises(ValueError, match="multiple of"):
+      train_qtopt(
+          learner=learner,
+          model_dir=str(tmp_path / "bad"),
+          max_train_steps=10,
+          batch_size=8,
+          save_checkpoints_steps=5,
+          log_every_steps=5,
+          prefill_random=True,
+          steps_per_dispatch=4,
+      )
