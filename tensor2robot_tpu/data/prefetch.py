@@ -60,8 +60,12 @@ def stack_batches(stream: Iterator[Any], k: int) -> Iterator[Any]:
   one stacked block per device program. A finite stream that runs dry
   mid-stack ends the output stream cleanly (the partial stack is
   dropped — PEP 479 would otherwise turn the inner StopIteration into
-  a RuntimeError and crash the run past its final checkpoint).
+  a RuntimeError and crash the run past its final checkpoint) and the
+  drop is LOGGED: a dataset whose length isn't a multiple of K trains
+  up to K-1 fewer steps than K=1 would, and that must not be silent.
   """
+  import logging
+
   it = iter(stream)
   while True:
     batches = []
@@ -69,9 +73,48 @@ def stack_batches(stream: Iterator[Any], k: int) -> Iterator[Any]:
       try:
         batches.append(next(it))
       except StopIteration:
+        if batches:
+          logging.getLogger(__name__).warning(
+              "steps_per_dispatch=%d dropped a partial tail of %d "
+              "batch(es): the finite input stream's length is not a "
+              "multiple of K, so this run trains %d fewer step(s) "
+              "than K=1 would.", k, len(batches), len(batches))
         return
     yield jax.tree_util.tree_map(
         lambda *xs: np.stack(xs), *batches)
+
+
+def scan_k_steps(step_fn, state, stacked_batches, rng, step0):
+  """K train steps as one traced program (the dispatch body both
+  trainers jit — shared so the iterations_per_loop semantics cannot
+  diverge between them, the same reason `validate_steps_per_dispatch`
+  is shared).
+
+  Args:
+    step_fn: (state, *batch_parts, rng) → (state, metrics) — the
+      per-step train function.
+    state: the carried TrainState (donated by the caller's jit).
+    stacked_batches: TUPLE of [K, B, ...]-stacked pytrees; scanned
+      together, so each scan step sees the tuple's per-step slices.
+    rng: the per-run step PRNG base key.
+    step0: absolute step of the dispatch's first step; each scanned
+      step folds `rng` by `step0 + i` — the per-step PRNG stream is
+      IDENTICAL to K=1 (the equivalence both trainers' tests pin).
+
+  Returns (state, last step's metrics) — hooks/logging observe only
+  each dispatch's final step, the TPUEstimator quantization contract.
+  """
+  from jax import numpy as jnp
+
+  def body(carry, xs):
+    st, i = carry
+    st, metrics = step_fn(*((st,) + xs),
+                          jax.random.fold_in(rng, step0 + i))
+    return (st, i + 1), metrics
+
+  (state, _), metrics_seq = jax.lax.scan(
+      body, (state, jnp.zeros((), jnp.int32)), stacked_batches)
+  return state, jax.tree_util.tree_map(lambda m: m[-1], metrics_seq)
 
 
 def stacked_sharding(sharding: jax.sharding.NamedSharding

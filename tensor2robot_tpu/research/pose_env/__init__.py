@@ -5,6 +5,9 @@ from tensor2robot_tpu.research.pose_env.pose_env import (
     collect_random_episodes,
     evaluate_pose_model,
 )
+from tensor2robot_tpu.research.pose_env.mujoco_pose_env import (
+    MuJoCoPoseEnv,
+)
 from tensor2robot_tpu.research.pose_env.pose_env_models import (
     PoseEnvRegressionModel,
 )

@@ -7,13 +7,18 @@ minimal proof that specs → data → train → export → predict all work
 (SURVEY.md §3 "pose_env"; file:line unavailable — empty reference mount).
 
 This rebuild ships a dependency-free numpy renderer with the same task
-semantics (PyBullet isn't in the image; if `pybullet` is importable a
-physics-backed variant could subclass `PoseEnv`). An episode: a block
-is placed at a uniform random planar pose on a table; the observation
+semantics, plus a PHYSICS-BACKED variant
+(`mujoco_pose_env.MuJoCoPoseEnv`, round 5): PyBullet isn't in the
+image but MuJoCo is, so the physics env drops the block and lets
+contact dynamics settle it — the label is the settled pose. (Camera
+rendering stays numpy in both: MuJoCo's renderer needs a GL context
+and the image has none — osmesa/egl/glfw all fail to load.) An
+episode: a block lands at a planar pose on a table; the observation
 is an RGB render; the label is the pose. `collect_random_episodes`
 writes spec-conforming TFRecords, `evaluate_pose_model` scores a
 predictor by mean pose error — the same collect/eval loop shape the
-reference's scripts had.
+reference's scripts had; both take `env_cls` so the physics variant
+is a gin switch.
 """
 
 from __future__ import annotations
@@ -86,6 +91,7 @@ def collect_random_episodes(
     num_episodes: int = 100,
     image_size: int = IMAGE_SIZE,
     seed: int = 0,
+    env_cls: type = None,
 ) -> str:
   """Renders random poses into a TFRecord file of {image, target_pose}.
 
@@ -100,7 +106,7 @@ def collect_random_episodes(
   )
   from tensor2robot_tpu.data.abstract_input_generator import Mode
 
-  env = PoseEnv(image_size=image_size, seed=seed)
+  env = (env_cls or PoseEnv)(image_size=image_size, seed=seed)
   model = PoseEnvRegressionModel(image_size=image_size)
   examples = []
   for _ in range(num_episodes):
@@ -122,6 +128,7 @@ def evaluate_pose_model(
     image_size: int = IMAGE_SIZE,
     seed: int = 1,
     success_threshold: float = 0.05,
+    env_cls: type = None,
 ) -> Dict[str, float]:
   """Rolls the env and scores predicted poses against ground truth.
 
@@ -129,7 +136,7 @@ def evaluate_pose_model(
   value is the predicted pose (the predictor API). Returns mean L2 pose
   error and success rate at `success_threshold` world units.
   """
-  env = PoseEnv(image_size=image_size, seed=seed)
+  env = (env_cls or PoseEnv)(image_size=image_size, seed=seed)
   errors: List[float] = []
   for _ in range(num_episodes):
     obs = env.reset()

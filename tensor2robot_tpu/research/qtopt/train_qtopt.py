@@ -105,7 +105,7 @@ def train_qtopt(
   # before the prefetcher exists: raising later would leak both past
   # their teardown owner (the loop's try/finally).
   step = int(np.asarray(jax.device_get(state.step)))
-  if k > 1 and step % k:
+  if k > 1 and step % k and step < max_train_steps:
     metric_logger.close()
     raise ValueError(
         f"Resumed at step {step}, not a multiple of "
@@ -132,18 +132,9 @@ def train_qtopt(
     stream = replay_buffer.as_stream(batch_size)
     stream_sharding = data_sharding
   else:
-    from jax import numpy as jnp
-
     def k_steps(st, stacked, rng, step0):
-      def body(carry, xs):
-        st, i = carry
-        st, metrics = learner.train_step(
-            st, xs, jax.random.fold_in(rng, step0 + i))
-        return (st, i + 1), metrics
-      (st, _), metrics_seq = jax.lax.scan(
-          body, (st, jnp.zeros((), jnp.int32)), stacked)
-      # Hooks/logging observe the dispatch's LAST step only.
-      return st, jax.tree_util.tree_map(lambda m: m[-1], metrics_seq)
+      return prefetch_lib.scan_k_steps(
+          learner.train_step, st, (stacked,), rng, step0)
 
     stacked_sharding = prefetch_lib.stacked_sharding(data_sharding)
     train_step = jax.jit(

@@ -214,16 +214,9 @@ def train_eval_model(
         mesh_lib.batch_sharding(mesh))
 
     def k_steps(st, stacked_features, stacked_labels, rng, step0):
-      def body(carry, xs):
-        st, i = carry
-        features, labels = xs
-        st, metrics = model.train_step(
-            st, features, labels, jax.random.fold_in(rng, step0 + i))
-        return (st, i + 1), metrics
-      (st, _), metrics_seq = jax.lax.scan(
-          body, (st, jnp.zeros((), jnp.int32)),
-          (stacked_features, stacked_labels))
-      return st, jax.tree_util.tree_map(lambda m: m[-1], metrics_seq)
+      return prefetch_lib.scan_k_steps(
+          model.train_step, st, (stacked_features, stacked_labels),
+          rng, step0)
 
     train_step = jax.jit(
         k_steps,
