@@ -10,22 +10,26 @@ block_k) tiles, scores live only in registers/VMEM, and the online
 softmax carries running max/normalizer/accumulator in f32 scratch.
 
 Measured on v5e at T=32768 causal (scan-amortized, D2H-barriered),
-round-5 committed run: forward 32.6 TFLOP/s at D=64 / 46.7 at D=128
-(16.8 / 11.8 ms — `BENCH_DETAIL.json` → `long_context[_d128]`) —
-where the materialized XLA attention OOMs beyond T≈4096. (Round 3
+round-5 committed run: forward 27.0 TFLOP/s at D=64 / 37.8 at D=128
+(`BENCH_DETAIL.json` → `long_context[_d128]`; quieter-tunnel session
+trials ran up to ~33/47 — the committed record is the citable number)
+— where the materialized XLA attention OOMs beyond T≈4096. (Round 3
 recorded 147 TFLOP/s for this kernel; that number does not reproduce
 under the hardened timing methodology and is retracted — see
 bench.py's docstring for why early numbers were tunnel artifacts;
 round 4's honest rebuild measured 24–36.) Round-5 gains came from a
-block sweep on hardware: (block_q, block_k) = (1024, 2048) default —
+block sweep on hardware — (block_q, block_k) = (1024, 2048) default:
 fewer, larger grid steps amortize both Mosaic's per-step overhead and
-the online-softmax rescale chain. The remaining gap to peak is
-structural at D=64: the score/PV matmuls contract only 64 lanes of
-the 128-wide MXU, and the online-softmax VPU work (exp, max, rescale)
-is comparable to the matmul time at these tile shapes — confirmed
-empirically by the SAME kernel at D=128 (H halved, identical FLOPs)
-running consistently faster. Models that care about attention
-throughput at long context should prefer MXU-width heads.
+the online-softmax rescale chain — plus tri-regime causal tiles (see
+`_flash_kernel`): fully-past tiles skip the mask iotas/selects
+entirely, only diagonal-straddling tiles pay for masking (measured
+~3-4%). The remaining gap to peak is structural at D=64: the score/PV
+matmuls contract only 64 lanes of the 128-wide MXU, and the
+online-softmax VPU work (exp, max, rescale) is comparable to the
+matmul time at these tile shapes — confirmed empirically by the SAME
+kernel at D=128 (H halved, identical FLOPs) running consistently
+faster. Models that care about attention throughput at long context
+should prefer MXU-width heads.
 
 Training works end to end, and the backward is Pallas too (new in
 round 5; the round-4 backward was a scanned XLA program): two kernels
@@ -35,15 +39,15 @@ dk/dv per K-block over the Q grid, `_dq_kernel` accumulates dq per
 Q-block over the K grid. The softmax-jacobian row term
 D_i = rowsum(dO·O) (minus any lse cotangent) is a cheap XLA
 elementwise reduce computed once outside. No [T, T] tensor exists in
-either direction; causal work-skipping applies to both directions
-(fully-masked tile pairs skip under pl.when). Measured train step
-(fwd+bwd) at T=32k causal: 41.6 → 29.3 ms at D=64 (1.42×) and
-28.0 → 20.7 ms at D=128 (1.35×; 17.7 ms = 1.58× in a quieter-tunnel
-trial) vs the round-4 XLA backward — the backward portion alone
-dropped ~22.6 → ~12.5 ms, and the total is now FORWARD-bound (the
-backward kernels have no sequential max/rescale chain, so their five
-matmuls per tile pair run at higher MXU occupancy than the forward's
-two).
+either direction; the tri-regime causal tiling applies to both
+directions (fully-future tiles skip compute, fully-past tiles skip
+the mask work). Measured train step (fwd+bwd) at T=32k causal,
+final committed run: 41.6 → 27.7 ms at D=64 (1.50×) and
+28.0 → 15.9 ms at D=128 (1.76×) vs the round-4 XLA backward — the
+backward portion dropped ~22.6 → ~7-12 ms, and the total is now
+FORWARD-bound (the backward kernels have no sequential max/rescale
+chain, so their five matmuls per tile pair run at higher MXU
+occupancy than the forward's two).
 
 Pairs with `parallel/ring_attention.py`: the ring shards the sequence
 ACROSS chips (ppermute over ICI), this kernel tiles it WITHIN a chip;
@@ -90,6 +94,25 @@ def _auto_block(requested: int, t: int) -> int:
   return b
 
 
+def _causal_tile_regimes(row_block, col_block, block_q: int,
+                         block_k: int):
+  """(not_future, fully_past) predicates for one causal score tile.
+
+  Shared by all three kernels so forward and backward can never
+  disagree on which tiles are masked:
+    fully-future (not not_future): every col > every row — all-masked,
+      skip the tile's compute entirely;
+    fully_past: every col <= every row — mask is all-true, run the
+      unmasked update (no iota/select work);
+    otherwise the tile straddles the diagonal and pays for masking.
+  """
+  last_row = row_block * block_q + block_q - 1
+  first_row = row_block * block_q
+  first_col = col_block * block_k
+  last_col = col_block * block_k + block_k - 1
+  return first_col <= last_row, last_col <= first_row
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
                   acc_scr, *, scale: float, causal: bool, block_q: int,
                   block_k: int, num_k_blocks: int):
@@ -105,31 +128,30 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
     l_scr[...] = jnp.zeros_like(l_scr)
     acc_scr[...] = jnp.zeros_like(acc_scr)
 
-  # program_id must be read OUTSIDE the pl.when body (the interpreter
-  # cannot lower it inside the conditional); the mask rides in via
-  # closure.
-  mask = None
-  if causal:
-    i = pl.program_id(1)
-    rows = i * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0)
-    cols = j * block_k + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1)
-    mask = cols <= rows
+  # program_id must be read OUTSIDE the pl.when bodies (the
+  # interpreter cannot lower it inside the conditional); the mask
+  # itself is built INSIDE the masked branch so unmasked tiles pay
+  # for neither the iotas nor the selects.
+  i = pl.program_id(1) if causal else None
 
-  def _update():
+  def _update_impl(use_mask):
     q = q_ref[0]  # [block_q, D]
     k = k_ref[0]  # [block_k, D]
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale  # [bq, bk]
-    if causal:
+    if use_mask:
+      rows = i * block_q + jax.lax.broadcasted_iota(
+          jnp.int32, (block_q, block_k), 0)
+      cols = j * block_k + jax.lax.broadcasted_iota(
+          jnp.int32, (block_q, block_k), 1)
+      mask = cols <= rows
       s = jnp.where(mask, s, _NEG_INF)
 
     m_prev = m_scr[...]
     m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
     p = jnp.exp(s - m_new)
-    if causal:
+    if use_mask:
       p = jnp.where(mask, p, 0.0)
     alpha = jnp.exp(m_prev - m_new)
     l_scr[...] = alpha * l_scr[...] + p.sum(axis=-1, keepdims=True)
@@ -139,12 +161,19 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
     m_scr[...] = m_new
 
   if causal:
-    # Fully-future K blocks (every col > every row) contribute zero:
-    # skip their compute entirely — half the grid at long T. (K/V
-    # block DMAs still stream; the saving is the MXU/VPU work.)
-    pl.when(j * block_k <= i * block_q + block_q - 1)(_update)
+    # Tri-regime causal tiling (see _causal_tile_regimes): at T=32k
+    # with bq=1024/bk=2048 only ~1 straddling block per q row pays
+    # for the mask iotas + selects; fully-future tiles (half the
+    # grid) skip all compute. (`fully_past` implies `not_future`,
+    # but the conjunction keeps the two pl.when predicates visibly
+    # disjoint-and-exhaustive over the not-future half.)
+    not_future, fully_past = _causal_tile_regimes(
+        i, j, block_q, block_k)
+    pl.when(not_future & fully_past)(lambda: _update_impl(False))
+    pl.when(not_future & jnp.logical_not(fully_past))(
+        lambda: _update_impl(True))
   else:
-    _update()
+    _update_impl(False)
 
   @pl.when(j == num_k_blocks - 1)
   def _finalize():
@@ -246,15 +275,7 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dk_scr[...] = jnp.zeros_like(dk_scr)
     dv_scr[...] = jnp.zeros_like(dv_scr)
 
-  mask = None
-  if causal:
-    rows = qi * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0)
-    cols = j * block_k + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1)
-    mask = cols <= rows
-
-  def _update():
+  def _update_impl(use_mask):
     q = q_ref[0]                                   # [bq, D]
     k = k_ref[0]                                   # [bk, D]
     v = v_ref[0]
@@ -264,10 +285,15 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale  # [bq, bk]
-    if causal:
+    if use_mask:
+      rows = qi * block_q + jax.lax.broadcasted_iota(
+          jnp.int32, (block_q, block_k), 0)
+      cols = j * block_k + jax.lax.broadcasted_iota(
+          jnp.int32, (block_q, block_k), 1)
+      mask = cols <= rows
       s = jnp.where(mask, s, _NEG_INF)
     p = jnp.exp(s - lse)
-    if causal:
+    if use_mask:
       p = jnp.where(mask, p, 0.0)
     # dv += pᵀ·dO. p/ds cast to the input dtype for the MXU matmul
     # (f32 accumulation via preferred_element_type) — the standard
@@ -284,12 +310,15 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         preferred_element_type=jnp.float32)
 
   if causal:
-    # A Q block fully ABOVE this K block (every row < every col) is
-    # fully masked: skip — the backward mirror of the forward's
-    # future-K skip, half the grid at long T.
-    pl.when(qi * block_q + block_q - 1 >= j * block_k)(_update)
+    # Same tri-regime tiling as the forward (shared predicates).
+    not_future, fully_past = _causal_tile_regimes(
+        qi, j, block_q, block_k)
+    pl.when(not_future & fully_past)(
+        lambda: _update_impl(False))
+    pl.when(not_future & jnp.logical_not(fully_past))(
+        lambda: _update_impl(True))
   else:
-    _update()
+    _update_impl(False)
 
   @pl.when(qi == num_q_blocks - 1)
   def _finalize():
@@ -309,15 +338,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
   def _init():
     dq_scr[...] = jnp.zeros_like(dq_scr)
 
-  mask = None
-  if causal:
-    rows = i * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0)
-    cols = kj * block_k + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1)
-    mask = cols <= rows
-
-  def _update():
+  def _update_impl(use_mask):
     q = q_ref[0]
     k = k_ref[0]
     v = v_ref[0]
@@ -327,10 +348,15 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale
-    if causal:
+    if use_mask:
+      rows = i * block_q + jax.lax.broadcasted_iota(
+          jnp.int32, (block_q, block_k), 0)
+      cols = kj * block_k + jax.lax.broadcasted_iota(
+          jnp.int32, (block_q, block_k), 1)
+      mask = cols <= rows
       s = jnp.where(mask, s, _NEG_INF)
     p = jnp.exp(s - lse)
-    if causal:
+    if use_mask:
       p = jnp.where(mask, p, 0.0)
     dp = jax.lax.dot_general(
         do, v, (((1,), (1,)), ((), ())),
@@ -341,10 +367,15 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         preferred_element_type=jnp.float32)
 
   if causal:
-    # Fully-future K blocks contribute zero ds: same skip as forward.
-    pl.when(kj * block_k <= i * block_q + block_q - 1)(_update)
+    # Same tri-regime tiling as the forward (shared predicates).
+    not_future, fully_past = _causal_tile_regimes(
+        i, kj, block_q, block_k)
+    pl.when(not_future & fully_past)(
+        lambda: _update_impl(False))
+    pl.when(not_future & jnp.logical_not(fully_past))(
+        lambda: _update_impl(True))
   else:
-    _update()
+    _update_impl(False)
 
   @pl.when(kj == num_k_blocks - 1)
   def _finalize():
