@@ -41,6 +41,18 @@ from tensor2robot_tpu.parallel import (
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def _tiny_pipelined_model_kwargs(**overrides):
+  """One copy of the small pipelined-BC model config (the serving and
+  compose tests must stay on the SAME architecture)."""
+  kwargs = dict(
+      image_size=24, filters=(8,), embedding_size=16, width=32,
+      depth=4, num_heads=2, max_context_length=64,
+      attention_impl="reference", pipeline_stages=4,
+      pipeline_microbatches=2)
+  kwargs.update(overrides)
+  return kwargs
+
+
 def _trunk(mesh, **overrides):
   kwargs = dict(width=32, depth=4, num_heads=2, max_len=16,
                 num_stages=4, num_microbatches=2, mesh=mesh,
@@ -208,10 +220,7 @@ class TestPipelinedBCByConfig:
 
     _, model_dir, _ = run
     serving = VRGripperTransformerModel(
-        image_size=24, filters=(8,), embedding_size=16, width=32,
-        depth=4, num_heads=2, max_context_length=64,
-        attention_impl="reference", pipeline_stages=4,
-        pipeline_microbatches=2, device_dtype=jnp.float32)
+        device_dtype=jnp.float32, **_tiny_pipelined_model_kwargs())
     state = serving.create_inference_state(jax.random.PRNGKey(0))
     variables = ckpt_lib.restore_variables(
         model_dir, like={"params": state.params,
@@ -225,3 +234,41 @@ class TestPipelinedBCByConfig:
     })
     assert out["action"].shape == (1, 3)
     assert np.isfinite(out["action"]).all()
+
+
+def test_pipeline_strategy_composes_with_steps_per_dispatch(tmp_path):
+  """The two round-5 trainer capabilities compose: a stage-sharded
+  pipelined model trains through K-scanned dispatches (the scan body
+  carries the stage-stacked TrainState with its pipeline shardings)."""
+  from tensor2robot_tpu import train_eval
+  from tensor2robot_tpu.data import RandomInputGenerator
+  from tensor2robot_tpu.models import optimizers as opt_lib
+  from tensor2robot_tpu.research.vrgripper import (
+      VRGripperTransformerModel,
+  )
+
+  mesh = create_mesh({DATA_AXIS: 2, STAGE_AXIS: 4})
+  model = VRGripperTransformerModel(
+      mesh=mesh, device_dtype=jnp.float32,
+      create_optimizer_fn=lambda: opt_lib.create_optimizer(
+          learning_rate=1e-3),
+      **_tiny_pipelined_model_kwargs())
+  state = train_eval.train_eval_model(
+      model=model,
+      model_dir=str(tmp_path / "m"),
+      input_generator_train=RandomInputGenerator(
+          batch_size=8, sequence_length=8),
+      max_train_steps=4,
+      save_checkpoints_steps=4,
+      log_every_steps=2,
+      batch_size=8,
+      init_batch_size=8,
+      mesh=mesh,
+      sharding_strategy="pipeline",
+      steps_per_dispatch=2,
+  )
+  assert int(np.asarray(jax.device_get(state.step))) == 4
+  stages = state.params["trunk"]["stages"]
+  assert any(
+      STAGE_AXIS in jax.tree.leaves(tuple(l.sharding.spec))
+      for l in jax.tree.leaves(stages))

@@ -341,3 +341,24 @@ def test_steps_per_dispatch_rejects_misaligned_cadence(tmp_path):
         log_every_steps=5,
         steps_per_dispatch=4,
     )
+
+
+def test_completed_run_reinvoked_with_k_noops(tmp_path):
+  """Re-invoking a finished run with steps_per_dispatch>1 must no-op
+  (resume sees step >= max_train_steps), not raise on alignment."""
+  kwargs = dict(
+      model=MockT2RModel(),
+      model_dir=str(tmp_path / "m"),
+      input_generator_train=RandomInputGenerator(batch_size=8),
+  )
+  train_eval.train_eval_model(
+      max_train_steps=5, save_checkpoints_steps=5, log_every_steps=5,
+      **kwargs)
+  # Resume step 5 is NOT a multiple of K=4, but the run is already
+  # complete at max_train_steps=4: the alignment check must not fire
+  # for a no-op invocation (cadences here are K-aligned, so only the
+  # resume-alignment guard is exercised).
+  state = train_eval.train_eval_model(
+      max_train_steps=4, save_checkpoints_steps=4, log_every_steps=4,
+      steps_per_dispatch=4, **kwargs)
+  assert int(np.asarray(jax.device_get(state.step))) == 5
