@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Tier-1 verify: the ROADMAP.md command VERBATIM (same log path, same
+# DOTS_PASSED accounting the driver greps), then the serving-bench
+# smoke (one small bucket table on CPU, no BENCH_DETAIL.json write) so
+# the serving bench path itself is exercised by tier-1 tooling.
+#
+# Usage: scripts/tier1.sh   (from the repo root)
+set -u
+cd "$(dirname "$0")/.."
+
+set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+
+echo "--- serving bench smoke (bench.py --serving --dry-run) ---"
+env JAX_PLATFORMS=cpu python bench.py --serving --dry-run
+smoke_rc=$?
+
+if [ "$rc" -ne 0 ]; then exit "$rc"; fi
+exit "$smoke_rc"

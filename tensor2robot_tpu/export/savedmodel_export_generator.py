@@ -52,7 +52,9 @@ class SavedModelExportGenerator(AbstractExportGenerator):
                export_dir_base: Optional[str] = None,
                include_tf_example_signature: bool = True,
                batch_polymorphic: bool = True,
-               sequence_example_length: Optional[int] = None):
+               sequence_example_length: Optional[int] = None,
+               serving_max_batch: Optional[int] = None,
+               serving_max_wait_us: int = 200):
     """Args:
       export_dir_base: where timestamped exports land.
       include_tf_example_signature: also emit a serialized-proto
@@ -65,11 +67,20 @@ class SavedModelExportGenerator(AbstractExportGenerator):
       batch_polymorphic: symbolic batch dim in the exported graph.
       sequence_example_length: static time-axis length the
         tf.SequenceExample parse signature pads/truncates episodes to.
+      serving_max_batch: when set, the recommended low-latency serving
+        config (powers-of-two bucket table up to this max, plus the
+        micro-batch deadline below) ships in the asset payload under
+        `extra["serving"]` — fleet consumers configure their bucketed
+        engines from the export alone (docs/SERVING.md).
+      serving_max_wait_us: recommended micro-batch coalescing deadline
+        recorded alongside the bucket table.
     """
     super().__init__(export_dir_base)
     self._include_tf_example_signature = include_tf_example_signature
     self._batch_polymorphic = batch_polymorphic
     self._sequence_example_length = sequence_example_length
+    self._serving_max_batch = serving_max_batch
+    self._serving_max_wait_us = serving_max_wait_us
 
   def export(self, model: Any, state: Any, model_dir: str) -> str:
     from jax.experimental import jax2tf  # lazy: TF import is slow
@@ -186,12 +197,21 @@ class SavedModelExportGenerator(AbstractExportGenerator):
 
     assets_dir = os.path.join(tmp_dir, "assets.extra")
     os.makedirs(assets_dir, exist_ok=True)
+    extra = None
+    if self._serving_max_batch is not None:
+      from tensor2robot_tpu.serving.bucketing import bucket_table
+      extra = {"serving": {
+          "max_batch": int(self._serving_max_batch),
+          "bucket_sizes": list(bucket_table(self._serving_max_batch)),
+          "max_wait_us": int(self._serving_max_wait_us),
+      }}
     specs_lib.write_assets(
         os.path.join(assets_dir, specs_lib.ASSET_FILENAME),
         feature_spec,
         label_spec=model.preprocessor.get_in_label_specification(
             Mode.PREDICT),
-        global_step=state_step)
+        global_step=state_step,
+        extra=extra)
     # Atomic publish: pollers never observe a half-written SavedModel.
     os.rename(tmp_dir, export_dir)
     return export_dir

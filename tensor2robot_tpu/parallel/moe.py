@@ -49,7 +49,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
-from tensor2robot_tpu.parallel.mesh import EXPERT_AXIS
+from tensor2robot_tpu.parallel.mesh import EXPERT_AXIS, shard_map_compat
 
 _EPS = 1e-9
 
@@ -202,14 +202,17 @@ class MoEMLP(nn.Module):
     e, h = self.num_experts, self.hidden_dim
     init = nn.initializers.lecun_normal()
     router = self.param("router", init, (model_dim, e), jnp.float32)
-    # "expert_" prefix is the contract `expert_sharding` keys on.
-    w_in = self.param("expert_w_in", init, (e, model_dim, h),
+    # The "moe_expert_" prefix is the contract `expert_sharding` keys
+    # on: it is OWNED by this module (nothing else may name params
+    # with it), so expert weights shard correctly no matter what the
+    # parent trunk names its MoEMLP instance.
+    w_in = self.param("moe_expert_w_in", init, (e, model_dim, h),
                       jnp.float32).astype(self.dtype)
-    b_in = self.param("expert_b_in", nn.initializers.zeros,
+    b_in = self.param("moe_expert_b_in", nn.initializers.zeros,
                       (e, h), jnp.float32).astype(self.dtype)
-    w_out = self.param("expert_w_out", init, (e, h, model_dim),
+    w_out = self.param("moe_expert_w_out", init, (e, h, model_dim),
                        jnp.float32).astype(self.dtype)
-    b_out = self.param("expert_b_out", nn.initializers.zeros,
+    b_out = self.param("moe_expert_b_out", nn.initializers.zeros,
                        (e, model_dim), jnp.float32).astype(self.dtype)
 
     x = x.astype(self.dtype)
@@ -245,11 +248,10 @@ class MoEMLP(nn.Module):
           mean_axes=token_axes)
       tok = P(token_axes)
       ep = P(EXPERT_AXIS)
-      out, aux = jax.shard_map(
-          body, mesh=mesh,
+      out, aux = shard_map_compat(
+          body, mesh,
           in_specs=(tok, P(), ep, ep, ep, ep),
           out_specs=(tok, P()),
-          check_vma=False,
       )(tokens, router, w_in, b_in, w_out, b_out)
     self.sow("aux_loss", "moe_aux", aux)
     return out.reshape(b, t, model_dim)

@@ -35,7 +35,11 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tensor2robot_tpu.parallel.mesh import DATA_AXIS, STAGE_AXIS
+from tensor2robot_tpu.parallel.mesh import (
+    DATA_AXIS,
+    STAGE_AXIS,
+    shard_map_compat,
+)
 
 
 def init_stage_params(
@@ -167,9 +171,8 @@ def pipeline_apply(
       axis_name=axis_name, remat=remat)
   data_axis = DATA_AXIS if DATA_AXIS in mesh.axis_names else None
   xspec = P(None, data_axis)
-  out = jax.shard_map(
-      body, mesh=mesh,
+  out = shard_map_compat(
+      body, mesh,
       in_specs=(P(STAGE_AXIS), xspec), out_specs=xspec,
-      check_vma=False,
   )(stage_params, micro)
   return out.reshape(x.shape)

@@ -37,7 +37,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tensor2robot_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS
+from tensor2robot_tpu.parallel.mesh import (
+    DATA_AXIS,
+    SEQ_AXIS,
+    shard_map_compat,
+)
 
 _NEG_INF = -1e30  # finite sentinel: avoids -inf - -inf = nan paths
 
@@ -245,10 +249,9 @@ def ring_attention(
         _ring_attention_local, axis_name=axis_name, causal=causal)
   else:
     raise ValueError(f"Unknown block_impl: {block_impl!r}")
-  fn = jax.shard_map(
+  fn = shard_map_compat(
       lambda q, k, v: local(q, k, v),
-      mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-      check_vma=False)
+      mesh, in_specs=(spec, spec, spec), out_specs=spec)
   return fn(q, k, v)
 
 

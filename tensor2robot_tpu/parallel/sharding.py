@@ -103,15 +103,19 @@ def expert_sharding(mesh: Mesh, tree: Any,
   """fsdp rules + expert weights sharded over the `expert` axis.
 
   Keys on the `MoEMLP` param-name contract: a leaf is an expert weight
-  iff its own name is ``expert_``-prefixed (the stacked [E, ...] expert
-  weights) AND it lives directly under a ``moe`` module (the name the
-  transformer trunk instantiates `MoEMLP` as) or at the tree root (a
-  bare `MoEMLP` param tree). Matching leaves put their leading expert
-  dim on `expert`; an indivisible leading dim raises (silently falling
-  back to fsdp would replicate expert weights a pod expects sharded).
-  Everything else (router, attention, dense trunk — and every optimizer
-  mirror, which shares its param's path) follows the fsdp rule. With no
-  `expert` mesh axis this IS `fsdp_sharding`.
+  iff its own name is ``moe_expert_``-prefixed — the stacked [E, ...]
+  expert weights. That prefix is OWNED by `MoEMLP` (`parallel/moe.py`
+  names every stacked expert param with it and nothing else may), so
+  the rule is mount-point independent: a trunk may instantiate its
+  MoEMLP under any module name and the experts still shard. (The old
+  contract additionally required the parent module to be literally
+  named ``moe``, which silently REPLICATED experts mounted under any
+  other name — round-5 advisor finding.) Matching leaves put their
+  leading expert dim on `expert`; an indivisible leading dim raises
+  (silently falling back to fsdp would replicate expert weights a pod
+  expects sharded). Everything else (router, attention, dense trunk —
+  and every optimizer mirror, which shares its param's path) follows
+  the fsdp rule. With no `expert` mesh axis this IS `fsdp_sharding`.
   """
   if EXPERT_AXIS not in mesh.axis_names:
     return fsdp_sharding(mesh, tree, min_size_to_shard)
@@ -119,10 +123,8 @@ def expert_sharding(mesh: Mesh, tree: Any,
 
   def rule(path, leaf):
     shape = getattr(leaf, "shape", ())
-    is_expert = (path
-                 and _path_key_name(path[-1]).startswith("expert_")
-                 and (len(path) == 1
-                      or _path_key_name(path[-2]) == "moe"))
+    is_expert = bool(
+        path and _path_key_name(path[-1]).startswith("moe_expert_"))
     if is_expert:
       if not shape or shape[0] % size != 0:
         raise ValueError(

@@ -9,6 +9,13 @@ TPU-native: `predict_step` is jitted once; checkpoint refreshes swap the
 param pytree without recompiling (same treedef/shapes). Runs on
 whatever the local jax backend is — TPU chip on the robot's host, or
 CPU.
+
+Serving mode (`max_batch` set): the jitted-per-call path is replaced by
+the `serving` engine — per-bucket AOT-compiled programs warmed at
+construction, donated request buffers, a pinned device-resident params
+tree that `restore()` hot-swaps lock-free, and a micro-batcher so
+concurrent `predict()` callers coalesce into shared dispatches (see
+docs/SERVING.md).
 """
 
 from __future__ import annotations
@@ -33,7 +40,16 @@ class CheckpointPredictor(AbstractPredictor):
   """Serves a model directly from its training checkpoints."""
 
   def __init__(self, model, checkpoint_dir: Optional[str] = None,
-               init_batch_size: int = 1):
+               init_batch_size: int = 1,
+               max_batch: Optional[int] = None,
+               max_wait_us: int = 200,
+               warmup: bool = True):
+    """`max_batch=None` keeps the classic one-jit path. Setting it
+    turns on the serving engine: powers-of-two buckets up to
+    `max_batch` are AOT-compiled (at construction when `warmup`, else
+    on first use), and `predict()` goes through a micro-batcher with a
+    `max_wait_us` coalescing deadline — thread-safe, so one predictor
+    serves many control loops."""
     self._model = model
     self._checkpoint_dir = checkpoint_dir
     # Inference-only state: no optimizer moments on the robot.
@@ -45,6 +61,19 @@ class CheckpointPredictor(AbstractPredictor):
     # against it every control tick, so compute it once.
     self._feature_spec = specs_lib.flatten_spec_structure(
         model.preprocessor.get_in_feature_specification(Mode.PREDICT))
+    self._engine = None
+    self._batcher = None
+    if max_batch is not None:
+      from tensor2robot_tpu.serving import (
+          BucketedServingEngine,
+          MicroBatcher,
+      )
+      example = specs_lib.make_random_tensors(
+          self._feature_spec, batch_size=1, seed=0)
+      self._engine = BucketedServingEngine(
+          model.predict_step, self._state, example, max_batch=max_batch)
+      self.warmup_seconds = self._engine.warmup() if warmup else 0.0
+      self._batcher = MicroBatcher(self._engine, max_wait_us=max_wait_us)
 
   @property
   def feature_specification(self) -> TensorSpecStruct:
@@ -83,15 +112,32 @@ class CheckpointPredictor(AbstractPredictor):
         params=variables["params"],
         batch_stats=variables.get("batch_stats", {}))
     self._restored_step = step
+    if self._engine is not None:
+      # Publish to the serving engine only after the FULL restore
+      # above succeeded: in-flight dispatches keep the old tree, the
+      # next dispatch reads the new one — never a mix.
+      self._engine.swap_state(self._state)
     return True
 
   def predict(self, features: Dict[str, np.ndarray]) -> Dict[str, Any]:
     self.assert_is_loaded()
     packed = self._validate(features)
     arrays = jax.tree_util.tree_map(np.asarray, packed)
-    outputs = self._predict(self._state, arrays)
+    if self._batcher is not None:
+      outputs = self._batcher.predict(arrays)
+    else:
+      outputs = self._predict(self._state, arrays)
     if isinstance(outputs, TensorSpecStruct):
       outputs = outputs.to_flat_dict()
     if not isinstance(outputs, dict):
       outputs = {"output": outputs}
     return {k: np.asarray(jax.device_get(v)) for k, v in outputs.items()}
+
+  @property
+  def serving_engine(self):
+    """The serving-mode engine (None on the classic path)."""
+    return self._engine
+
+  def close(self) -> None:
+    if self._batcher is not None:
+      self._batcher.close()

@@ -42,6 +42,7 @@ class SavedModelPredictor(AbstractPredictor):
     self._serving_fn = None
     self._feature_spec: Optional[TensorSpecStruct] = None
     self._label_spec: Optional[TensorSpecStruct] = None
+    self._serving_metadata: Optional[dict] = None
     self._version = -1
     self._global_step = -1
 
@@ -61,6 +62,13 @@ class SavedModelPredictor(AbstractPredictor):
   @property
   def global_step(self) -> int:
     return self._global_step
+
+  @property
+  def serving_metadata(self) -> Optional[dict]:
+    """The exporter's recommended serving config (bucket table,
+    micro-batch deadline) from the asset payload, when shipped —
+    fleet consumers size their engines from this (docs/SERVING.md)."""
+    return self._serving_metadata
 
   def restore(self, timeout_secs: Optional[float] = None,
               poll_interval_secs: float = 1.0) -> bool:
@@ -100,6 +108,7 @@ class SavedModelPredictor(AbstractPredictor):
     self._feature_spec = assets["feature_spec"]
     self._label_spec = assets.get("label_spec")
     self._global_step = assets.get("global_step", -1)
+    self._serving_metadata = assets.get("extra", {}).get("serving")
     self._version = version
 
   def predict(self, features: Dict[str, np.ndarray]) -> Dict[str, Any]:

@@ -64,6 +64,15 @@ class MuJoCoPoseEnv(PoseEnv):
                drop_height: float = 0.25,
                max_settle_steps: int = 1500,
                settle_speed: float = 1e-3):
+    # Config validation BEFORE the mujoco import: a zero/negative step
+    # budget would otherwise surface as a NameError deep inside
+    # `_settle_once` (the settle loop body never runs, so `step` is
+    # unbound) instead of a config error at construction.
+    if max_settle_steps < 1:
+      raise ValueError(
+          f"max_settle_steps must be >= 1 (got {max_settle_steps}): "
+          "the settle loop needs at least one physics step to produce "
+          "a pose.")
     super().__init__(image_size=image_size, seed=seed,
                      block_half_extent=block_half_extent, noise=noise)
     # Imported lazily so the numpy env never needs it.

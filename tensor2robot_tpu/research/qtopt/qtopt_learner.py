@@ -79,6 +79,14 @@ class QTOptLearner:
   def model(self) -> GraspingQModel:
     return self._model
 
+  @property
+  def cem_population(self) -> int:
+    return self._cem_population
+
+  @property
+  def cem_iterations(self) -> int:
+    return self._cem_iterations
+
   def create_state(self, rng: jax.Array,
                    batch_size: int = 2) -> QTOptState:
     train_state = self._model.create_train_state(rng, batch_size)
@@ -201,6 +209,15 @@ class QTOptLearner:
       return result.best_action
 
     return policy
+
+  def observation_specification(self) -> TensorSpecStruct:
+    """Serving-side observation spec: every state feature Q(s, ·)
+    conditions on — the model's TRAIN feature spec minus the `action`
+    CEM optimizes over. This is the wire contract of
+    `serving.CEMPolicyServer.select_actions`."""
+    feat = self._model.get_feature_specification(Mode.TRAIN).to_flat_dict()
+    return TensorSpecStruct.from_flat_dict(
+        {k: v for k, v in feat.items() if k != "action"})
 
   def transition_specification(self) -> TensorSpecStruct:
     """The replay-buffer transition spec, derived from the model specs."""

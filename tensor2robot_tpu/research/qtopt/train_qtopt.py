@@ -67,6 +67,17 @@ def train_qtopt(
   multiple of K, per-step hooks observe only each dispatch's LAST
   metrics, and the per-step PRNG stream is identical to K=1 (folded
   by absolute step inside the scan).
+
+  ONLINE-run caveat (K>1 sampling lead): replay batches for a whole
+  K-step dispatch are sampled BEFORE the dispatch runs, and the
+  prefetcher keeps up to 2 dispatches in flight, so with actors
+  feeding the buffer concurrently the last step of a dispatch can
+  train on samples drawn up to ~3K steps of parameter updates ago.
+  The exact-K=1-equivalence claim (and its tests) is therefore scoped
+  to static/offline buffers — logged episodes, prefill_random — where
+  sample timing is irrelevant; online runs should treat K as a
+  throughput/off-policy-staleness trade-off (QT-Opt's replay regime
+  tolerates staleness, but it is a semantic difference, not a no-op).
   """
   if mesh is None:
     mesh = mesh_lib.create_mesh()

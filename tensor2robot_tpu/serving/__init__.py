@@ -1,0 +1,32 @@
+"""Low-latency serving: bucketed AOT compilation + dynamic micro-batching.
+
+The on-robot deployment metric is per-call `predict()` latency — the
+control loop blocks on it every action (SURVEY.md §4.4) — and the
+north-star deployment serves many concurrent control loops from one
+chip. This package turns the predictors' one-request-per-dispatch path
+into a serving engine:
+
+  * `bucketing` — powers-of-two batch buckets and batch-dim padding,
+    so every request shape maps onto a finite, pre-compilable set of
+    device programs.
+  * `engine.BucketedServingEngine` — per-bucket AOT-compiled programs
+    (zero retraces/recompiles on the hot path after `warmup()`),
+    donated input buffers, and a pinned device-resident params tree
+    shared across buckets with lock-free hot-swap on refresh.
+  * `microbatcher.MicroBatcher` — a thread-safe queue that coalesces
+    concurrent `predict()` calls into one device dispatch under a
+    max-batch / max-wait-µs deadline (the Podracer batched-inference
+    idiom), with graceful single-request fallback.
+  * `cem_policy.CEMPolicyServer` — the QT-Opt action-selection entry:
+    batched on-device CEM behind the engine + micro-batcher.
+"""
+
+from tensor2robot_tpu.serving.bucketing import (
+    bucket_for,
+    bucket_table,
+    pad_batch,
+    unpad_batch,
+)
+from tensor2robot_tpu.serving.engine import BucketedServingEngine
+from tensor2robot_tpu.serving.microbatcher import MicroBatcher
+from tensor2robot_tpu.serving.cem_policy import CEMPolicyServer

@@ -1,0 +1,70 @@
+"""Batch-bucket math: powers-of-two buckets + batch-dim padding.
+
+XLA programs are shape-specialized: serving a request of every batch
+size 1..N would compile N programs (and recompile on the first sight of
+each size — a multi-second stall inside a robot's control tick). The
+standard fix is a finite bucket table: requests pad up to the next
+power-of-two bucket, so the engine pre-compiles log2(max_batch)+1
+programs once at startup and the hot path never traces again.
+
+Padding rows replicate the request's LAST real row rather than zeros:
+replicated rows are guaranteed in-distribution for any per-row network
+(no NaN/inf hazards from all-zero images through normalization layers),
+and per-row inference is row-independent — inference-mode batch norm
+uses stored statistics — so pad rows cannot change real rows' outputs
+(pinned by tests/test_serving.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple
+
+import numpy as np
+
+
+def bucket_table(max_batch: int) -> Tuple[int, ...]:
+  """Powers of two 1, 2, 4, ... covering `max_batch` (last ≥ max_batch)."""
+  if max_batch < 1:
+    raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+  table = []
+  b = 1
+  while b < max_batch:
+    table.append(b)
+    b *= 2
+  table.append(b)
+  return tuple(table)
+
+
+def bucket_for(n: int, table: Sequence[int]) -> int:
+  """Smallest bucket holding n rows; raises when n exceeds the table."""
+  if n < 1:
+    raise ValueError(f"batch size must be >= 1, got {n}")
+  for b in table:
+    if n <= b:
+      return b
+  raise ValueError(
+      f"batch size {n} exceeds the largest bucket {table[-1]}; raise "
+      f"max_batch or split the request.")
+
+
+def _pad_rows(array: np.ndarray, bucket: int) -> np.ndarray:
+  n = array.shape[0]
+  if n == bucket:
+    return array
+  pad = np.repeat(array[-1:], bucket - n, axis=0)
+  return np.concatenate([array, pad], axis=0)
+
+
+def pad_batch(tree: Any, bucket: int) -> Any:
+  """Pads every leaf's leading dim up to `bucket` (last-row replication)."""
+  import jax
+
+  return jax.tree_util.tree_map(
+      lambda a: _pad_rows(np.asarray(a), bucket), tree)
+
+
+def unpad_batch(tree: Any, n: int) -> Any:
+  """Slices every leaf back to the request's true n rows."""
+  import jax
+
+  return jax.tree_util.tree_map(lambda a: a[:n], tree)

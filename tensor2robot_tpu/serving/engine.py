@@ -1,0 +1,174 @@
+"""Bucketed AOT serving engine: pre-compiled programs, pinned params.
+
+One `BucketedServingEngine` owns everything shape-dependent about the
+hot path:
+
+  * a per-bucket COMPILE CACHE of ahead-of-time compiled executables
+    (`jax.jit(...).lower(...).compile()` at warmup) — the hot path
+    calls finished executables, so it can never trace or recompile;
+  * ONE device-resident state (params + batch stats) pytree shared by
+    every bucket's program — buckets multiply compiled code, never
+    parameter memory;
+  * lock-free hot-swap: `swap_state` transfers the new tree, blocks
+    until every buffer is materialized on device, then publishes it
+    with a single reference assignment (atomic under the GIL). Calls
+    in flight keep the tree they already read — a dispatch observes
+    entirely-old or entirely-new params, never a mix;
+  * donated request buffers: the padded features are donated into the
+    program (`donate_argnums`), letting XLA alias their device memory
+    for outputs instead of allocating per call.
+
+The wrapped `fn(state, features[, rng])` must be pure and jittable with
+a leading batch dim on every feature/output leaf (a model's
+`predict_step`, or a CEM policy closure).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import numpy as np
+
+from tensor2robot_tpu.serving import bucketing
+
+# Process-wide count of engine bucket compiles — tests pin "zero
+# recompiles after warmup" against it alongside jax.monitoring events.
+_COMPILE_COUNT = 0
+
+
+def compile_count() -> int:
+  return _COMPILE_COUNT
+
+
+class BucketedServingEngine:
+  """Serves `fn` over powers-of-two batch buckets, AOT-compiled."""
+
+  def __init__(self,
+               fn: Callable,
+               state: Any,
+               example_features: Any,
+               max_batch: int = 8,
+               takes_rng: bool = False,
+               donate_features: bool = True):
+    """Args:
+      fn: pure `(state, features)` or `(state, features, rng)` callable.
+      state: the params pytree `fn` closes over per call; transferred
+        to device here and pinned (swaps must keep shapes/dtypes).
+      example_features: a features pytree with ANY leading batch dim —
+        only its per-row shapes/dtypes matter (bucket avals are derived
+        from it).
+      max_batch: largest servable request; the bucket table covers it.
+      takes_rng: whether `fn` threads a PRNG key (CEM policies).
+      donate_features: donate the padded request buffers into the
+        program.
+    """
+    self._fn = fn
+    self._takes_rng = takes_rng
+    self._table = bucketing.bucket_table(max_batch)
+    self._row_avals = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(np.asarray(a).shape[1:],
+                                       np.asarray(a).dtype),
+        example_features)
+    placed = jax.device_put(state)
+    jax.block_until_ready(placed)
+    self._state = placed
+    self._compiled: Dict[int, Any] = {}
+    donate = (1,) if donate_features else ()
+    self._jitted = jax.jit(fn, donate_argnums=donate)
+    self._swap_lock = threading.Lock()
+    self.dispatch_count = 0
+    self.dispatches_per_bucket: Dict[int, int] = {}
+    self.swap_count = 0
+
+  @property
+  def bucket_sizes(self):
+    return self._table
+
+  @property
+  def max_batch(self) -> int:
+    return self._table[-1]
+
+  @property
+  def compiled_buckets(self):
+    return tuple(sorted(self._compiled))
+
+  # ---- compilation ----
+
+  def _feature_avals(self, bucket: int):
+    return jax.tree_util.tree_map(
+        lambda sd: jax.ShapeDtypeStruct((bucket,) + sd.shape, sd.dtype),
+        self._row_avals)
+
+  def _compile_bucket(self, bucket: int) -> None:
+    global _COMPILE_COUNT
+    import warnings
+
+    args = [self._state, self._feature_avals(bucket)]
+    if self._takes_rng:
+      args.append(jax.ShapeDtypeStruct((2,), np.uint32))
+    with warnings.catch_warnings():
+      # Donation is best-effort: when no output matches a donated
+      # input's shape/dtype XLA simply doesn't alias, which is fine —
+      # the advisory warning would spam every warmup.
+      warnings.filterwarnings(
+          "ignore", message=".*donated buffers were not usable.*")
+      self._compiled[bucket] = self._jitted.lower(*args).compile()
+    _COMPILE_COUNT += 1
+
+  def warmup(self) -> float:
+    """AOT-compiles every bucket; returns wall seconds spent.
+
+    Run at startup, BEFORE traffic: after it returns, every request
+    size ≤ max_batch hits a finished executable and the control loop
+    never absorbs a compile stall.
+    """
+    t0 = time.perf_counter()
+    for bucket in self._table:
+      if bucket not in self._compiled:
+        self._compile_bucket(bucket)
+    return time.perf_counter() - t0
+
+  # ---- params hot-swap ----
+
+  def swap_state(self, new_state: Any) -> None:
+    """Publishes a fully-materialized new params tree (lock-free reads).
+
+    The swap lock only serializes concurrent SWAPPERS (checkpoint
+    poller vs. manual refresh); readers never take it — they grab the
+    current reference once per dispatch.
+    """
+    with self._swap_lock:
+      placed = jax.device_put(new_state)
+      # Block BEFORE publishing: a dispatch must never race ahead of
+      # a half-transferred restore.
+      jax.block_until_ready(placed)
+      self._state = placed
+      self.swap_count += 1
+
+  # ---- the hot path ----
+
+  def predict(self, features: Any,
+              rng: Optional[jax.Array] = None) -> Any:
+    """One bucketed dispatch; returns host numpy outputs, unpadded."""
+    leaves = jax.tree_util.tree_leaves(features)
+    n = int(np.asarray(leaves[0]).shape[0])
+    bucket = bucketing.bucket_for(n, self._table)
+    if bucket not in self._compiled:
+      # Cold bucket (warmup skipped): compile once, counted. Never
+      # taken after warmup() — the table is fully populated there.
+      self._compile_bucket(bucket)
+    padded = bucketing.pad_batch(features, bucket)
+    state = self._state  # one atomic read: old or new tree, never mixed
+    if self._takes_rng:
+      outputs = self._compiled[bucket](state, padded, rng)
+    else:
+      outputs = self._compiled[bucket](state, padded)
+    self.dispatch_count += 1
+    self.dispatches_per_bucket[bucket] = (
+        self.dispatches_per_bucket.get(bucket, 0) + 1)
+    outputs = jax.tree_util.tree_map(
+        lambda a: np.asarray(jax.device_get(a)), outputs)
+    return bucketing.unpad_batch(outputs, n)

@@ -1,0 +1,185 @@
+"""Dynamic micro-batching: coalesce concurrent predicts into one dispatch.
+
+Many robots (or sim actors, or RPC handlers) each want ONE action per
+control tick; the chip wants one big batch per program launch. The
+micro-batcher sits between them: callers block on `predict()`, a single
+dispatcher thread drains the request queue into the largest batch the
+deadline allows (≤ the engine's max_batch, ≤ max_wait_µs of queueing),
+pads it onto a bucket via the engine, and scatters per-caller slices
+back. Under load, N concurrent callers cost ~one dispatch instead of N
+(the Podracer batched-inference idiom, PAPERS.md); a lone caller waits
+at most the deadline — and with `max_wait_us=0` not at all (graceful
+single-request fallback: an empty queue dispatches the first request
+immediately).
+
+Correctness contract (pinned by tests/test_serving.py): per-caller
+results are exactly the rows an unbatched `engine.predict` would have
+produced — coalescing and padding are invisible to callers.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+
+class _Request:
+
+  __slots__ = ("features", "n", "future")
+
+  def __init__(self, features: Any, n: int):
+    self.features = features
+    self.n = n
+    self.future: Future = Future()
+
+
+class MicroBatcher:
+  """Coalesces concurrent requests onto a `BucketedServingEngine`."""
+
+  def __init__(self, engine, max_wait_us: int = 200,
+               rng: Optional[jax.Array] = None):
+    """Args:
+      engine: a `BucketedServingEngine` (owns buckets + compiled code).
+      max_wait_us: how long a dispatch may hold its FIRST request while
+        waiting for more to coalesce. 0 = never wait (single-request
+        fallback only coalesces what is already queued).
+      rng: base PRNG key for rng-taking engines (CEM policies); folded
+        per dispatch so coalesced callers draw distinct action noise.
+    """
+    self._engine = engine
+    self._max_wait = max_wait_us / 1e6
+    self._rng = rng
+    self._dispatch_index = 0
+    self._carry: Optional[_Request] = None
+    self._queue: "queue.Queue[_Request]" = queue.Queue()
+    self._stop = threading.Event()
+    # Serializes submit()'s closed-check+enqueue against close()'s
+    # stop: without it a request could land on the queue after the
+    # dispatcher decided to exit and block its caller forever.
+    self._submit_lock = threading.Lock()
+    self.dispatches = 0
+    self.requests = 0
+    self.batch_sizes: List[int] = []
+    self._thread = threading.Thread(target=self._run, daemon=True)
+    self._thread.start()
+
+  # ---- caller side ----
+
+  def submit(self, features: Dict[str, np.ndarray]) -> Future:
+    """Enqueues one request (1..max_batch rows); returns its Future."""
+    leaves = jax.tree_util.tree_leaves(features)
+    n = int(np.asarray(leaves[0]).shape[0])
+    if n > self._engine.max_batch:
+      raise ValueError(
+          f"request of {n} rows exceeds the engine's max_batch "
+          f"{self._engine.max_batch}; split it or raise max_batch.")
+    request = _Request(features, n)
+    with self._submit_lock:
+      if self._stop.is_set():
+        raise RuntimeError("MicroBatcher is closed.")
+      self.requests += 1
+      self._queue.put(request)
+    return request.future
+
+  def predict(self, features: Dict[str, np.ndarray]) -> Any:
+    """Blocking predict — what a control loop calls each tick."""
+    return self.submit(features).result()
+
+  # ---- dispatcher thread ----
+
+  def _take_batch(self) -> List[_Request]:
+    """First request (blocking) + whatever coalesces before deadline."""
+    if self._carry is not None:
+      first, self._carry = self._carry, None
+    else:
+      try:
+        first = self._queue.get(timeout=0.05)
+      except queue.Empty:
+        return []
+    batch = [first]
+    rows = first.n
+    deadline = time.perf_counter() + self._max_wait
+    while rows < self._engine.max_batch:
+      remaining = deadline - time.perf_counter()
+      try:
+        # With max_wait_us=0 this still drains already-queued requests
+        # but never holds the first one waiting for arrivals.
+        nxt = (self._queue.get(timeout=remaining) if remaining > 0
+               else self._queue.get_nowait())
+      except queue.Empty:
+        break
+      if rows + nxt.n > self._engine.max_batch:
+        # Doesn't fit this dispatch: carry it over to LEAD the next
+        # one (a FIFO re-put would let later arrivals jump ahead).
+        self._carry = nxt
+        break
+      batch.append(nxt)
+      rows += nxt.n
+    return batch
+
+  def _run(self) -> None:
+    while (not self._stop.is_set() or not self._queue.empty()
+           or self._carry is not None):
+      batch = self._take_batch()
+      if not batch:
+        continue
+      self._dispatch(batch)
+
+  def _dispatch(self, batch: List[_Request]) -> None:
+    try:
+      features = jax.tree_util.tree_map(
+          lambda *leaves: np.concatenate(
+              [np.asarray(a) for a in leaves], axis=0),
+          *[r.features for r in batch])
+      if self._rng is not None:
+        key = jax.random.fold_in(self._rng, self._dispatch_index)
+        outputs = self._engine.predict(features, rng=key)
+      else:
+        outputs = self._engine.predict(features)
+      self._dispatch_index += 1
+      self.dispatches += 1
+      self.batch_sizes.append(sum(r.n for r in batch))
+      offset = 0
+      for request in batch:
+        lo, hi = offset, offset + request.n
+        # copy(): slices of one shared output buffer would let a
+        # caller's in-place post-processing corrupt its co-batched
+        # callers' rows.
+        request.future.set_result(jax.tree_util.tree_map(
+            lambda a: a[lo:hi].copy(), outputs))
+        offset = hi
+    except Exception as exc:  # noqa: BLE001 — deliver to every caller
+      for request in batch:
+        if not request.future.done():
+          request.future.set_exception(exc)
+
+  # ---- lifecycle ----
+
+  def close(self, timeout: float = 30.0) -> None:
+    """Drains queued requests, then stops the dispatcher thread."""
+    with self._submit_lock:
+      self._stop.set()
+    self._thread.join(timeout=timeout)
+    # Defensive: if the dispatcher thread died or timed out, fail any
+    # stranded requests instead of hanging their callers.
+    while True:
+      try:
+        request = self._queue.get_nowait()
+      except queue.Empty:
+        break
+      if not request.future.done():
+        request.future.set_exception(
+            RuntimeError("MicroBatcher closed before dispatch."))
+
+  def __enter__(self):
+    return self
+
+  def __exit__(self, *exc):
+    self.close()
+    return False

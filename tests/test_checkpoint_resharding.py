@@ -72,7 +72,7 @@ class TestExpertShardedCheckpoints:
     # Restore back onto the expert layout: leaves adopt the sharding.
     restored_ep = ckpt_lib.restore_state(str(tmp_path), like=sharded)
     _values_equal(restored_ep, sharded)
-    ew = restored_ep["block1"]["moe"]["expert_w_in"]
+    ew = restored_ep["block1"]["moe"]["moe_expert_w_in"]
     assert ew.sharding.spec[0] == EXPERT_AXIS, ew.sharding
 
   def test_fsdp_trained_state_restores_onto_expert_mesh(self, tmp_path):
@@ -97,7 +97,7 @@ class TestExpertShardedCheckpoints:
         params, expert_sharding(mesh_b, params, min_size_to_shard=64))
     restored = ckpt_lib.restore_state(str(tmp_path), like=like)
     _values_equal(restored, under_fsdp)
-    ew = restored["block1"]["moe"]["expert_w_in"]
+    ew = restored["block1"]["moe"]["moe_expert_w_in"]
     assert ew.sharding.spec[0] == EXPERT_AXIS, ew.sharding
 
 

@@ -88,7 +88,7 @@ class TestPipelinedTrunk:
         lambda v: jnp.sum(pipelined.apply(v, x) ** 2))(variables)
     seq_grads = jax.grad(
         lambda v: jnp.sum(sequential.apply(v, x) ** 2))(variables)
-    flat_pp = jax.tree.leaves_with_path(pp_grads)
+    flat_pp = jax.tree_util.tree_leaves_with_path(pp_grads)
     flat_seq = jax.tree.leaves(seq_grads)
     assert flat_pp and len(flat_pp) == len(flat_seq)
     for (path, pg), sg in zip(flat_pp, flat_seq):
@@ -126,7 +126,7 @@ class TestPipelinedTrunk:
     x = jnp.zeros((2, 8, 4), jnp.float32)
     variables = _trunk(None).init(jax.random.PRNGKey(0), x)
     stages = variables["params"]["stages"]
-    for path, leaf in jax.tree.leaves_with_path(stages):
+    for path, leaf in jax.tree_util.tree_leaves_with_path(stages):
       assert leaf.shape[0] == 4, (path, leaf.shape)
 
 
@@ -138,7 +138,7 @@ class TestPipelineSharding:
     params = _trunk(None).init(jax.random.PRNGKey(0), x)["params"]
     shardings = state_sharding(mesh, params, strategy="pipeline",
                                min_size_to_shard=64)
-    for path, sh in jax.tree.leaves_with_path(shardings):
+    for path, sh in jax.tree_util.tree_leaves_with_path(shardings):
       names = [str(getattr(k, "key", "")) for k in path]
       if "stages" in names:
         assert sh.spec == P(STAGE_AXIS), (path, sh)

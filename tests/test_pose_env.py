@@ -198,6 +198,17 @@ class TestMuJoCoPoseEnv:
     # pose — a kinematic env would move zero.
     assert np.mean(movements) > 0.01, movements
 
+  def test_zero_settle_steps_is_a_config_error_at_init(self):
+    """A step budget < 1 must raise at construction (it used to
+    surface as a NameError deep inside _settle_once — round-5 advisor
+    finding)."""
+    import pytest
+
+    from tensor2robot_tpu.research.pose_env import MuJoCoPoseEnv
+
+    with pytest.raises(ValueError, match="max_settle_steps"):
+      MuJoCoPoseEnv(seed=0, max_settle_steps=0)
+
   def test_settled_poses_stay_in_workspace_and_are_deterministic(self):
     from tensor2robot_tpu.research.pose_env import MuJoCoPoseEnv
     from tensor2robot_tpu.research.pose_env.pose_env import (

@@ -1,10 +1,12 @@
-"""Unit tests for the sharding-rule contracts tightened in round 5.
+"""Unit tests for the sharding-rule contracts.
 
-The advisor flagged `expert_sharding`'s name matching as too loose
-(any path segment starting with ``expert_``) and its indivisible-dim
-fallback as silent; the rule now requires the MoEMLP placement
-contract (an ``expert_*`` leaf directly under a ``moe`` module, or at
-the tree root for a bare MoEMLP tree) and raises on indivisibility.
+`expert_sharding` keys on the dedicated ``moe_expert_`` leaf prefix
+OWNED by `MoEMLP` — mount-point independent, so experts shard no
+matter what module name the trunk instantiates its MoEMLP under. (The
+previous contract required the parent module to be literally named
+``moe``, which silently replicated experts under any other mount —
+the round-5 advisor finding these tests regression-pin.) Indivisible
+expert dims raise instead of silently falling back.
 `xplane.is_async_window` (the compute-table filter behind the bench's
 per-op attribution) gets direct unit coverage too.
 """
@@ -31,47 +33,83 @@ class TestExpertShardingScope:
     return create_mesh({DATA_AXIS: 2, EXPERT_AXIS: 4})
 
   def test_expert_leaf_under_moe_shards_on_expert(self, mesh):
-    tree = {"block1": {"moe": {"expert_w_in": jnp.zeros((8, 16, 32))}}}
+    tree = {"block1": {"moe": {
+        "moe_expert_w_in": jnp.zeros((8, 16, 32))}}}
     sh = expert_sharding(mesh, tree, min_size_to_shard=64)
-    assert sh["block1"]["moe"]["expert_w_in"].spec == P(EXPERT_AXIS)
+    assert sh["block1"]["moe"]["moe_expert_w_in"].spec == P(EXPERT_AXIS)
+
+  def test_renamed_mount_still_shards(self, mesh):
+    """THE regression for the round-5 finding: a MoEMLP mounted under
+    a name other than 'moe' (here 'ffn_sparse') must still shard its
+    experts — the old parent-name contract silently replicated them."""
+    tree = {"block1": {"ffn_sparse": {
+        "moe_expert_w_in": jnp.zeros((8, 16, 32)),
+        "router": jnp.zeros((16, 8))}}}
+    sh = expert_sharding(mesh, tree, min_size_to_shard=64)
+    assert sh["block1"]["ffn_sparse"]["moe_expert_w_in"].spec == P(
+        EXPERT_AXIS)
+    # The router is not an expert weight wherever it lives.
+    router_spec = sh["block1"]["ffn_sparse"]["router"].spec
+    assert EXPERT_AXIS not in [ax for ax in router_spec if ax]
 
   def test_root_level_expert_leaf_shards(self, mesh):
     """A bare MoEMLP param tree has expert leaves at the root."""
-    sh = expert_sharding(mesh, {"expert_w_in": jnp.zeros((8, 16, 32))},
-                         min_size_to_shard=64)
-    assert sh["expert_w_in"].spec == P(EXPERT_AXIS)
+    sh = expert_sharding(
+        mesh, {"moe_expert_w_in": jnp.zeros((8, 16, 32))},
+        min_size_to_shard=64)
+    assert sh["moe_expert_w_in"].spec == P(EXPERT_AXIS)
 
   def test_optimizer_mirror_path_shards_too(self, mesh):
     """Adam moments nest the param path under opt-state prefixes; the
-    (parent == moe) scope must still match."""
+    leaf-name rule must still match."""
     tree = {"mu": {"trunk": {"moe": {
-        "expert_w_out": jnp.zeros((8, 32, 16))}}}}
+        "moe_expert_w_out": jnp.zeros((8, 32, 16))}}}}
     sh = expert_sharding(mesh, tree, min_size_to_shard=64)
-    assert sh["mu"]["trunk"]["moe"]["expert_w_out"].spec == P(
+    assert sh["mu"]["trunk"]["moe"]["moe_expert_w_out"].spec == P(
         EXPERT_AXIS)
 
-  def test_unrelated_expert_prefixed_leaf_uses_fsdp_rules(self, mesh):
-    """The advisor's collision case: an `expert_`-prefixed param NOT
-    under a moe module (here under an unrelated module) must follow
-    the fsdp rules — with no fsdp axis in this mesh, replicate —
-    instead of silently landing on the expert axis."""
-    tree = {"policy": {"expert_demo_encoder": jnp.zeros((8, 64, 64))}}
+  def test_expert_prefixed_leaf_outside_contract_uses_fsdp(self, mesh):
+    """The advisor's collision case: `expert_`-prefixed params that
+    are NOT MoEMLP's stacked weights (the prefix is `moe_expert_`,
+    which only MoEMLP may use) follow the fsdp rules — with no fsdp
+    axis in this mesh, replicate — instead of landing on the expert
+    axis."""
+    tree = {"policy": {"expert_demo_encoder": jnp.zeros((8, 64, 64))},
+            "moe": {"expert_w_in": jnp.zeros((8, 16, 32))}}
     sh = expert_sharding(mesh, tree, min_size_to_shard=64)
-    spec = sh["policy"]["expert_demo_encoder"].spec
-    assert EXPERT_AXIS not in [ax for ax in spec if ax], spec
+    for leaf in (sh["policy"]["expert_demo_encoder"],
+                 sh["moe"]["expert_w_in"]):
+      assert EXPERT_AXIS not in [ax for ax in leaf.spec if ax], leaf
 
   def test_indivisible_expert_dim_raises(self, mesh):
-    tree = {"moe": {"expert_w_in": jnp.zeros((6, 16, 32))}}
+    tree = {"moe": {"moe_expert_w_in": jnp.zeros((6, 16, 32))}}
     with pytest.raises(ValueError, match="not divisible"):
       expert_sharding(mesh, tree, min_size_to_shard=64)
 
   def test_no_expert_axis_falls_back_to_fsdp(self):
     mesh = create_mesh({DATA_AXIS: 8})
-    tree = {"moe": {"expert_w_in": jnp.zeros((6, 16, 32))}}
+    tree = {"moe": {"moe_expert_w_in": jnp.zeros((6, 16, 32))}}
     # No expert axis: the indivisible dim is irrelevant; fsdp rules
     # (here: replicated) apply without raising.
     sh = expert_sharding(mesh, tree, min_size_to_shard=64)
-    assert sh["moe"]["expert_w_in"].spec == P()
+    assert sh["moe"]["moe_expert_w_in"].spec == P()
+
+  def test_moe_mlp_param_names_carry_the_contract_prefix(self):
+    """The rule and the module must agree: every stacked expert param
+    MoEMLP creates is `moe_expert_`-prefixed (if this breaks, experts
+    replicate silently on pods)."""
+    import jax as _jax
+    from tensor2robot_tpu.parallel.moe import MoEMLP
+
+    module = MoEMLP(num_experts=4, hidden_dim=8, dtype=jnp.float32)
+    params = module.init(
+        _jax.random.PRNGKey(0), jnp.zeros((2, 4, 8)))["params"]
+    stacked = [name for name, leaf in params.items()
+               if np.asarray(leaf).ndim and
+               np.asarray(leaf).shape[0] == 4]
+    assert stacked, params.keys()
+    for name in stacked:
+      assert name.startswith("moe_expert_"), name
 
 
 class TestAsyncWindowFilter:

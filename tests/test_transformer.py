@@ -145,7 +145,7 @@ class TestCausalTransformer:
         lambda p: jnp.sum(ring_net.apply(p, x) ** 2))(variables)
     ref_grads = jax.grad(
         lambda p: jnp.sum(ref_net.apply(p, x) ** 2))(variables)
-    flat_ring = jax.tree.leaves_with_path(ring_grads)
+    flat_ring = jax.tree_util.tree_leaves_with_path(ring_grads)
     flat_ref = jax.tree.leaves(ref_grads)
     assert flat_ring and len(flat_ring) == len(flat_ref)
     for (path, rg), eg in zip(flat_ring, flat_ref):
