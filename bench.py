@@ -63,6 +63,17 @@ Usage: python bench.py [--paper] [--profile DIR] [--input] [--replay]
   --mxu      measure the 128-wide (MXU-filling) PRIMARY variant and
              record the committed flagship-width decision (steps/s is
              the target metric; the 64-wide step is HBM-bound).
+  --coldstart  the restart-latency axis (coldstart section): trainer
+             time-to-first-step and serving time-to-first-prediction,
+             each measured COLD-cache vs WARM-cache in fresh
+             subprocesses (the in-process jit cache cannot lie — only
+             the persistent XLA compilation cache and the orbax
+             checkpoint survive between runs), with a
+             jax.monitoring compile watch proving the warm path
+             performs ZERO XLA compilations (cache_misses == 0).
+             With --dry-run: tiny mock-model trainer probes on the
+             local backend, no BENCH_DETAIL.json write — the tier-1
+             smoke of the coldstart bench path itself.
   --serving  the low-latency serving axis (serving_latency section):
              CEM action-selection latency at batch=1 and batch=8
              through the bucketed AOT engine (p50/p95 over ≥100
@@ -902,6 +913,172 @@ def bench_long_context(t: int = 32768, heads: int = 4, d: int = 64,
   }
 
 
+def _run_coldstart_probe(kind: str, model_dir: str,
+                         cache_dir=None, tiny: bool = False,
+                         setup: bool = False, timeout: int = 1200):
+  """One coldstart probe subprocess; returns its COLDSTART_JSON dict
+  plus the parent-measured full process wall (imports included)."""
+  import subprocess
+
+  repo_root = os.path.dirname(os.path.abspath(__file__))
+  cmd = [sys.executable, "-m", "tensor2robot_tpu.startup.coldstart",
+         kind, "--model-dir", model_dir]
+  if cache_dir:
+    cmd += ["--cache-dir", cache_dir]
+  if tiny:
+    cmd.append("--tiny")
+  if setup:
+    cmd.append("--setup")
+  env = dict(os.environ)
+  env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+  # The probe's --cache-dir is the ONLY cache that may be in play: a
+  # fleet-wide T2R_COMPILATION_CACHE_DIR leaking in would hand the
+  # "cold" run a warm cache (and pollute production storage).
+  env.pop("T2R_COMPILATION_CACHE_DIR", None)
+  # Probes measure restarts on the REAL local backend; the tier-1
+  # suite's virtual 8-device CPU split is a test fixture, not a
+  # deployment shape — and jaxlib's CPU executable DEserialization
+  # corrupts the heap under it (warm runs segfault). Strip that one
+  # flag; everything else (platform selection included) passes through.
+  xla_flags = " ".join(
+      flag for flag in env.get("XLA_FLAGS", "").split()
+      if "xla_force_host_platform_device_count" not in flag)
+  if xla_flags:
+    env["XLA_FLAGS"] = xla_flags
+  else:
+    env.pop("XLA_FLAGS", None)
+  t0 = time.perf_counter()
+  out = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                       timeout=timeout, cwd=repo_root)
+  wall = time.perf_counter() - t0
+  if out.returncode != 0:
+    raise RuntimeError(
+        f"coldstart probe {cmd} failed rc={out.returncode}:\n"
+        f"{out.stdout[-2000:]}\n{out.stderr[-2000:]}")
+  marker = [line for line in out.stdout.splitlines()
+            if line.startswith("COLDSTART_JSON ")]
+  result = json.loads(marker[-1][len("COLDSTART_JSON "):])
+  result["process_wall_secs"] = round(wall, 3)
+  return result
+
+
+def bench_coldstart(dry_run: bool = False):
+  """The restart-latency axis: cold-cache vs warm-cache subprocesses.
+
+  Methodology: each measurement is one FULL process lifetime (see
+  startup/coldstart.py) — three runs per workload against a seeded
+  checkpoint: an untimed setup (cache disabled), a cold run against a
+  fresh persistent-cache dir (populates it), and a warm run against
+  the same dir. Trainer runs each resume from an identical copy of the
+  seeded model_dir, so cold and warm do the same restore + first-step
+  work and differ ONLY in cache state. The headline
+  `time_to_first_*_secs` starts at probe entry (imports excluded —
+  identical in both runs and unaddressable by caching);
+  `process_wall_secs` (parent-measured, imports included) rides along
+  for honesty. `warm.compile_watch.cache_misses == 0` is the
+  zero-XLA-compilations proof.
+  """
+  import shutil
+  import tempfile
+
+  tiny = dry_run
+  work = tempfile.mkdtemp(prefix="bench_coldstart_")
+  try:
+    # --- trainer: time-to-first-step ---
+    warm_trials = 1 if dry_run else 3
+    seed_dir = os.path.join(work, "trainer_seed")
+    _run_coldstart_probe("trainer", seed_dir, tiny=tiny, setup=True)
+    cache_dir = os.path.join(work, "cache_trainer")
+    def _trainer_run(tag):
+      run_dir = os.path.join(work, f"trainer_{tag}")
+      shutil.copytree(seed_dir, run_dir)
+      return _run_coldstart_probe(
+          "trainer", run_dir, cache_dir=cache_dir, tiny=tiny)
+    cold = _trainer_run("cold")
+    # The cold measurement is one-shot by construction (it populates
+    # the cache); warm restarts are the fleet's steady state, so the
+    # warm figure is the MEDIAN of several trials (this rig's restore
+    # wall varies 2-3x run to run; all trials are recorded).
+    warms = [_trainer_run(f"warm{i}") for i in range(warm_trials)]
+    warm_ttfs = sorted(
+        w["time_to_first_step_secs"] for w in warms)[warm_trials // 2]
+    trainer = {
+        "cold": cold,
+        "warm_trials": warms,
+        "warm_time_to_first_step_secs_median": warm_ttfs,
+        "warm_speedup_time_to_first_step": round(
+            cold["time_to_first_step_secs"] / max(warm_ttfs, 1e-9), 2),
+        "warm_speedup_process_wall": round(
+            cold["process_wall_secs"] / max(sorted(
+                w["process_wall_secs"] for w in warms)[warm_trials // 2],
+                1e-9), 2),
+        "warm_zero_xla_compilations": all(
+            w["compile_watch"]["cache_misses"] == 0
+            and w["compile_watch"]["cache_hits"] > 0 for w in warms),
+    }
+    if dry_run:
+      return {
+          "coldstart_dry_run": "ok",
+          "device_kind": warms[0]["device_kind"],
+          "cold_cache_misses":
+              cold["compile_watch"]["cache_misses"],
+          "warm_cache_misses":
+              warms[0]["compile_watch"]["cache_misses"],
+          "warm_cache_hits":
+              warms[0]["compile_watch"]["cache_hits"],
+          "warm_zero_xla_compilations":
+              trainer["warm_zero_xla_compilations"],
+      }
+
+    # --- serving: time-to-first-prediction ---
+    ckpt_dir = os.path.join(work, "serving_ckpt")
+    _run_coldstart_probe("serving", ckpt_dir, tiny=tiny, setup=True)
+    serving_cache = os.path.join(work, "cache_serving")
+    # The probe only reads the checkpoint; all runs share it.
+    srv_cold = _run_coldstart_probe(
+        "serving", ckpt_dir, cache_dir=serving_cache, tiny=tiny)
+    srv_warms = [
+        _run_coldstart_probe(
+            "serving", ckpt_dir, cache_dir=serving_cache, tiny=tiny)
+        for _ in range(warm_trials)]
+    warm_ttfp = sorted(
+        w["time_to_first_prediction_secs"]
+        for w in srv_warms)[warm_trials // 2]
+    serving = {
+        "cold": srv_cold,
+        "warm_trials": srv_warms,
+        "warm_time_to_first_prediction_secs_median": warm_ttfp,
+        "warm_speedup_time_to_first_prediction": round(
+            srv_cold["time_to_first_prediction_secs"]
+            / max(warm_ttfp, 1e-9), 2),
+        "warm_speedup_process_wall": round(
+            srv_cold["process_wall_secs"] / max(sorted(
+                w["process_wall_secs"]
+                for w in srv_warms)[warm_trials // 2], 1e-9), 2),
+        "warm_zero_xla_compilations": all(
+            w["compile_watch"]["cache_misses"] == 0
+            and w["compile_watch"]["cache_hits"] > 0
+            for w in srv_warms),
+    }
+    return {
+        "methodology": (
+            "subprocess per measurement (in-process jit cache cannot "
+            "lie); cold and warm runs do identical restore + "
+            "first-step/first-prediction work against the same seeded "
+            "checkpoint and differ only in persistent-cache state; "
+            "warm figure is the median of 3 trials (restore wall "
+            "varies run-to-run on a shared host), cold is one-shot "
+            "by construction; time_to_first_* starts at probe entry "
+            "(imports excluded, process_wall_secs includes them); "
+            "zero-compile proof is jax.monitoring cache_misses == 0 "
+            "on every warm trial"),
+        "trainer_time_to_first_step": trainer,
+        "serving_time_to_first_prediction": serving,
+    }
+  finally:
+    shutil.rmtree(work, ignore_errors=True)
+
+
 def _quantiles_ms(samples):
   return {
       "p50_ms": round(float(np.percentile(samples, 50)), 3),
@@ -1159,6 +1336,12 @@ def bench_input_pipeline(batch_size: int = 256, image_size: int = 64,
 
 def main():
   args = sys.argv[1:]
+  if "--coldstart" in args and "--dry-run" in args:
+    # Tier-1 smoke of the coldstart bench path: tiny mock-model
+    # trainer probes (setup/cold/warm subprocesses) on the local
+    # backend, NO detail-file write.
+    print(json.dumps(bench_coldstart(dry_run=True)))
+    return
   if "--serving" in args and "--dry-run" in args:
     # Tier-1 smoke of the serving bench path: tiny model, one small
     # bucket table, local backend, NO detail-file write (a CPU smoke
@@ -1239,6 +1422,8 @@ def main():
     detail["hardware_numerics"] = bench_verify_numerics()
   if "--serving" in args:
     detail["serving_latency"] = bench_serving()
+  if "--coldstart" in args:
+    detail["coldstart"] = bench_coldstart()
   if "--mxu" in args:
     # The MXU-width primary variant + the committed flagship-width
     # decision (round-5 verdict item 2), with THIS run's numbers

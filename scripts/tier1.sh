@@ -2,7 +2,9 @@
 # Tier-1 verify: the ROADMAP.md command VERBATIM (same log path, same
 # DOTS_PASSED accounting the driver greps), then the serving-bench
 # smoke (one small bucket table on CPU, no BENCH_DETAIL.json write) so
-# the serving bench path itself is exercised by tier-1 tooling.
+# the serving bench path itself is exercised by tier-1 tooling, then
+# the coldstart-bench smoke (tiny cold/warm trainer probes against a
+# throwaway persistent compile cache, no BENCH_DETAIL.json write).
 #
 # Usage: scripts/tier1.sh   (from the repo root)
 set -u
@@ -14,5 +16,10 @@ echo "--- serving bench smoke (bench.py --serving --dry-run) ---"
 env JAX_PLATFORMS=cpu python bench.py --serving --dry-run
 smoke_rc=$?
 
+echo "--- coldstart bench smoke (bench.py --coldstart --dry-run) ---"
+env JAX_PLATFORMS=cpu python bench.py --coldstart --dry-run
+coldstart_rc=$?
+
 if [ "$rc" -ne 0 ]; then exit "$rc"; fi
-exit "$smoke_rc"
+if [ "$smoke_rc" -ne 0 ]; then exit "$smoke_rc"; fi
+exit "$coldstart_rc"

@@ -65,6 +65,8 @@ class BucketedServingEngine:
       donate_features: donate the padded request buffers into the
         program.
     """
+    from tensor2robot_tpu.startup import compile_cache
+    compile_cache.configure_compilation_cache()
     self._fn = fn
     self._takes_rng = takes_rng
     self._table = bucketing.bucket_table(max_batch)
@@ -75,10 +77,30 @@ class BucketedServingEngine:
     placed = jax.device_put(state)
     jax.block_until_ready(placed)
     self._state = placed
+    # Buckets are LOWERED from these avals, never from the live state:
+    # a concrete-state lower would key the (persistent) compile cache
+    # on whatever tree `swap_state` last published, making a bucket
+    # compiled after a checkpoint restore hash differently from the
+    # same bucket compiled before it — nondeterministic cache keys
+    # across restarts. Swaps keep shapes/dtypes/shardings, so the
+    # avals stay valid for the engine's lifetime.
+    self._state_avals = jax.tree_util.tree_map(
+        compile_cache.aval_of, placed)
     self._compiled: Dict[int, Any] = {}
+    # Donation is disabled when the persistent cache is live on CPU —
+    # see compile_cache.donation_unsafe_with_cache (jaxlib heap bug).
+    if compile_cache.donation_unsafe_with_cache():
+      donate_features = False
     donate = (1,) if donate_features else ()
     self._jitted = jax.jit(fn, donate_argnums=donate)
     self._swap_lock = threading.Lock()
+    # Serializes bucket compilation: an async warmup (compile-ahead
+    # overlapped with a checkpoint restore) must never race a cold
+    # `predict` into compiling the same bucket twice.
+    self._compile_lock = threading.Lock()
+    self._warmup_thread: Optional[threading.Thread] = None
+    self._warmup_error: Optional[BaseException] = None
+    self.warmup_seconds: float = 0.0
     self.dispatch_count = 0
     self.dispatches_per_bucket: Dict[int, int] = {}
     self.swap_count = 0
@@ -106,17 +128,20 @@ class BucketedServingEngine:
     global _COMPILE_COUNT
     import warnings
 
-    args = [self._state, self._feature_avals(bucket)]
-    if self._takes_rng:
-      args.append(jax.ShapeDtypeStruct((2,), np.uint32))
-    with warnings.catch_warnings():
-      # Donation is best-effort: when no output matches a donated
-      # input's shape/dtype XLA simply doesn't alias, which is fine —
-      # the advisory warning would spam every warmup.
-      warnings.filterwarnings(
-          "ignore", message=".*donated buffers were not usable.*")
-      self._compiled[bucket] = self._jitted.lower(*args).compile()
-    _COMPILE_COUNT += 1
+    with self._compile_lock:
+      if bucket in self._compiled:
+        return  # lost a benign race to the warmup thread
+      args = [self._state_avals, self._feature_avals(bucket)]
+      if self._takes_rng:
+        args.append(jax.ShapeDtypeStruct((2,), np.uint32))
+      with warnings.catch_warnings():
+        # Donation is best-effort: when no output matches a donated
+        # input's shape/dtype XLA simply doesn't alias, which is fine —
+        # the advisory warning would spam every warmup.
+        warnings.filterwarnings(
+            "ignore", message=".*donated buffers were not usable.*")
+        self._compiled[bucket] = self._jitted.lower(*args).compile()
+      _COMPILE_COUNT += 1
 
   def warmup(self) -> float:
     """AOT-compiles every bucket; returns wall seconds spent.
@@ -129,7 +154,46 @@ class BucketedServingEngine:
     for bucket in self._table:
       if bucket not in self._compiled:
         self._compile_bucket(bucket)
-    return time.perf_counter() - t0
+    self.warmup_seconds = time.perf_counter() - t0
+    return self.warmup_seconds
+
+  def warmup_async(self) -> threading.Thread:
+    """Starts `warmup()` on a background thread (compile-ahead).
+
+    The cold-start overlap: callers kick this off, run their own
+    startup work (typically the checkpoint restore), then
+    `wait_warmup()`. Requests arriving mid-warmup are safe — the
+    compile lock serializes them with the warmup thread, and an
+    already-compiled bucket dispatches without waiting for the rest
+    of the table. Idempotent: a second call returns the live thread.
+    """
+    if self._warmup_thread is None:
+      def _run():
+        try:
+          self.warmup()
+        except BaseException as e:  # surfaced by wait_warmup()
+          self._warmup_error = e
+
+      self._warmup_thread = threading.Thread(
+          target=_run, name="engine-warmup", daemon=True)
+      self._warmup_thread.start()
+    return self._warmup_thread
+
+  def wait_warmup(self) -> float:
+    """Joins an async warmup; returns its wall seconds.
+
+    Re-raises whatever the warmup thread raised — on EVERY join, not
+    just the first: a failed warmup means uncompiled buckets, and a
+    later caller (a retried restore(), a warmup_seconds read) must
+    not be told the hot path is ready when it is not. No-op (0.0) if
+    `warmup_async` was never called.
+    """
+    if self._warmup_thread is None:
+      return 0.0
+    self._warmup_thread.join()
+    if self._warmup_error is not None:
+      raise self._warmup_error
+    return self.warmup_seconds
 
   # ---- params hot-swap ----
 

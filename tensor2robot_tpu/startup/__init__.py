@@ -1,0 +1,31 @@
+"""Cold-start elimination: persistent compile cache + overlapped startup.
+
+The north-star fleet restarts constantly — preemptible TPU workers,
+rolling predictor updates (Podracer, arXiv:2104.06272, makes
+preemption-tolerance a first-class property) — yet a process start
+serially pays trace + XLA compile + orbax restore + input-pipeline
+spin-up. This package makes restarts cheap and measured:
+
+  * `compile_cache` — gin-configurable wiring of jax's persistent XLA
+    compilation cache (`jax_compilation_cache_dir` + min-entry knobs),
+    shared by the trainer, predictors, the serving engine, and bench,
+    plus `CompileWatch`: a jax.monitoring tap that counts cache
+    hits/misses so "the warm path compiled nothing" is provable.
+  * `orchestrator` — `run_overlapped`: named startup phases on threads
+    (device compile, disk restore, host input prep don't contend),
+    with per-phase wall timings and the serial-vs-overlapped saving.
+  * `coldstart` — subprocess probes measuring trainer
+    time-to-first-step and predictor time-to-first-prediction, driven
+    by `bench.py --coldstart` (cold vs. warm cache).
+"""
+
+from tensor2robot_tpu.startup.compile_cache import (
+    CompileWatch,
+    aval_of,
+    cache_entry_count,
+    configure_compilation_cache,
+)
+from tensor2robot_tpu.startup.orchestrator import (
+    StartupReport,
+    run_overlapped,
+)

@@ -38,6 +38,8 @@ from tensor2robot_tpu.hooks import Hook, HookList
 from tensor2robot_tpu.models.model_interface import ModelInterface
 from tensor2robot_tpu.parallel import mesh as mesh_lib
 from tensor2robot_tpu.parallel import state_sharding
+from tensor2robot_tpu.startup import compile_cache
+from tensor2robot_tpu.startup import orchestrator
 from tensor2robot_tpu.utils import checkpoints as ckpt_lib
 
 log = logging.getLogger(__name__)
@@ -99,6 +101,67 @@ def _compile_steps(model: ModelInterface, mesh, donate: bool = True,
   return train_step, eval_step
 
 
+def _spec_batch_avals(spec, batch_size: int, sharding):
+  """Abstract [B, ...] batch pytree from a generator's (flat) wire spec.
+
+  The generators' contract is "spec-conforming numpy batches", so the
+  spec IS the aval source — AOT compilation never has to wait for the
+  input pipeline to produce a first batch.
+  """
+  if spec is None:
+    return None
+  return jax.tree_util.tree_map(
+      lambda s: jax.ShapeDtypeStruct(
+          (batch_size,) + tuple(s.shape), np.dtype(s.dtype),
+          sharding=sharding),
+      spec)
+
+
+def _batch_matches(avals, batch) -> bool:
+  """Does a concrete batch pytree carry exactly the predicted avals?"""
+  try:
+    if jax.tree_util.tree_structure(avals) != \
+        jax.tree_util.tree_structure(batch):
+      return False
+    return all(
+        tuple(a.shape) == tuple(np.shape(b))
+        and np.dtype(a.dtype) == np.result_type(b)
+        for a, b in zip(jax.tree_util.tree_leaves(avals),
+                        jax.tree_util.tree_leaves(batch)))
+  except Exception:
+    return False
+
+
+def _checked_aot(compiled, fallback, feature_avals, label_avals, what):
+  """Callable routing each batch to the AOT executable iff it matches
+  the spec-predicted avals, else to the lazy jit.
+
+  The spec contract makes a mismatch a generator bug, but a wrong
+  guess must degrade to a recompile (the pre-AOT behavior), never to
+  a crashed run — and a generator may diverge on ANY batch (e.g. a
+  short final batch), so every call is checked: a tree compare, ~µs
+  against a ms-scale dispatch.
+  """
+  if compiled is None:
+    return fallback
+  warned = []
+
+  def call(state, features, labels, *rest):
+    if (_batch_matches(feature_avals, features)
+        and _batch_matches(label_avals, labels)):
+      return compiled(state, features, labels, *rest)
+    if not warned:
+      warned.append(True)
+      log.warning(
+          "A batch does not match the AOT-compiled %s program's "
+          "spec-predicted avals (generator diverged from its spec?); "
+          "falling back to on-demand compilation for such batches.",
+          what)
+    return fallback(state, features, labels, *rest)
+
+  return call
+
+
 def _run_eval(model, eval_step, state, input_generator_eval, mesh,
               eval_steps: int, batch_size: Optional[int]) -> Dict[str, float]:
   """Averages eval metrics over `eval_steps` batches."""
@@ -145,6 +208,7 @@ def train_eval_model(
     seed: int = 0,
     init_batch_size: int = 2,
     steps_per_dispatch: int = 1,
+    overlap_startup: bool = True,
 ):
   """Trains (with interleaved eval) and exports; resumes automatically.
 
@@ -166,8 +230,21 @@ def train_eval_model(
   each dispatch's LAST metrics. The per-step PRNG stream is identical
   to K=1.
 
+  `overlap_startup` (default True) runs the three serial cold-start
+  phases concurrently — AOT `.lower().compile()` of the train/eval
+  programs (avals predicted from the generators' wire specs), the
+  orbax resume restore, and the input pipeline's spin-up/first-batch
+  prep — and writes per-phase timings to
+  `<model_dir>/startup_timings.json` (see docs/STARTUP.md). False is
+  the reference serial path: restore, then lazy jit at the first
+  step. Both paths are bitwise-identical in results; with a
+  persistent compilation cache configured
+  (`startup.configure_compilation_cache`), a warm restart skips XLA
+  entirely.
+
   Returns the final TrainState (on device, placed per the strategy).
   """
+  compile_cache.configure_compilation_cache()
   if mesh is None:
     mesh = mesh_lib.create_mesh()
   # Validate the dispatch quantization BEFORE any side effects.
@@ -195,23 +272,19 @@ def train_eval_model(
       min_size_to_shard=min_size_to_shard)
   state = jax.device_put(state, state_shardings)
   resume_step = ckpt_lib.latest_step(model_dir)
-  if resume_step is not None:
-    log.info("Resuming from checkpoint at step %d in %s", resume_step,
-             model_dir)
-    # Restored leaves adopt `state`'s shardings — checkpoints are
-    # portable across strategies/layouts (tests/test_checkpoint_resharding).
-    state = ckpt_lib.restore_state(model_dir, like=state,
-                                   step=resume_step)
 
-  writer = ckpt_lib.CheckpointWriter(
-      model_dir, max_to_keep=max_checkpoints_to_keep)
+  repl = mesh_lib.replicated(mesh)
+  batch_sh = mesh_lib.batch_sharding(mesh)
+  feed_sharding = batch_sh
+  # Donation is disabled when the persistent cache is live on CPU —
+  # see compile_cache.donation_unsafe_with_cache (jaxlib heap bug).
+  donate = not compile_cache.donation_unsafe_with_cache()
   train_step, eval_step = _compile_steps(
-      model, mesh, state_shardings=state_shardings)
+      model, mesh, donate=donate, state_shardings=state_shardings)
 
   if k > 1:
-    repl = mesh_lib.replicated(mesh)
-    stacked_sh = prefetch_lib.stacked_sharding(
-        mesh_lib.batch_sharding(mesh))
+    stacked_sh = prefetch_lib.stacked_sharding(batch_sh)
+    feed_sharding = stacked_sh
 
     def k_steps(st, stacked_features, stacked_labels, rng, step0):
       return prefetch_lib.scan_k_steps(
@@ -223,50 +296,177 @@ def train_eval_model(
         in_shardings=(state_shardings, stacked_sh, stacked_sh,
                       repl, repl),
         out_shardings=(state_shardings, repl),
-        donate_argnums=(0,),
+        donate_argnums=(0,) if donate else (),
     )
+
+  # --- overlapped cold-start: AOT compile ∥ restore ∥ input spin-up ---
+  # `will_train` over-approximates (a resume may already be past
+  # max_train_steps — unknowable until the restore lands); an unused
+  # prefetcher is closed without being consumed.
+  will_train = input_generator_train is not None and max_train_steps > 0
+
+  def _restore_phase():
+    # Restored leaves adopt `state`'s shardings — checkpoints are
+    # portable across strategies/layouts (tests/test_checkpoint_resharding).
+    return ckpt_lib.restore_state(model_dir, like=state,
+                                  step=resume_step)
+
+  def _input_phase():
+    stream = input_generator_train.create_dataset(
+        Mode.TRAIN, batch_size=batch_size)
+    if k > 1:
+      # Finite streams end cleanly mid-stack (the shared helper
+      # swallows the inner StopIteration PEP 479 would otherwise
+      # convert to a RuntimeError, preserving the final
+      # off-interval checkpoint below).
+      stream = prefetch_lib.stack_batches(stream, k)
+    return prefetch_lib.ShardedPrefetcher(
+        stream, feed_sharding, buffer_size=2)
+
+  def _stack_avals(avals, sharding):
+    return jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct((k,) + tuple(a.shape), a.dtype,
+                                       sharding=sharding), avals)
+
+  def _compile_phase():
+    # Avals come from the already-initialized `state` (the restore
+    # preserves shapes/dtypes/shardings by construction) and the
+    # generators' wire specs — nothing here waits on disk or on the
+    # input pipeline, which is the whole point.
+    out: Dict[str, Any] = {}
+    state_avals = jax.tree_util.tree_map(compile_cache.aval_of, state)
+    rng_aval = jax.ShapeDtypeStruct((2,), np.uint32, sharding=repl)
+    if will_train:
+      bs = batch_size or input_generator_train.batch_size
+      f_aval = _spec_batch_avals(
+          input_generator_train.feature_spec, bs, batch_sh)
+      l_aval = _spec_batch_avals(
+          input_generator_train.label_spec, bs, batch_sh)
+      if k > 1:
+        f_aval = _stack_avals(f_aval, stacked_sh)
+        l_aval = _stack_avals(l_aval, stacked_sh)
+      out["train_avals"] = (f_aval, l_aval)
+      try:
+        if k > 1:
+          step0_aval = jax.ShapeDtypeStruct((), np.int32, sharding=repl)
+          out["train"] = train_step.lower(
+              state_avals, f_aval, l_aval, rng_aval,
+              step0_aval).compile()
+        else:
+          out["train"] = train_step.lower(
+              state_avals, f_aval, l_aval, rng_aval).compile()
+      except Exception:
+        log.warning(
+            "AOT train-step compile failed; the first step will "
+            "compile on demand.", exc_info=True)
+    if input_generator_eval is not None:
+      ebs = (eval_batch_size or batch_size
+             or input_generator_eval.batch_size)
+      ef_aval = _spec_batch_avals(
+          input_generator_eval.feature_spec, ebs, batch_sh)
+      el_aval = _spec_batch_avals(
+          input_generator_eval.label_spec, ebs, batch_sh)
+      out["eval_avals"] = (ef_aval, el_aval)
+      try:
+        out["eval"] = eval_step.lower(
+            state_avals, ef_aval, el_aval).compile()
+      except Exception:
+        log.warning(
+            "AOT eval-step compile failed; the first eval will "
+            "compile on demand.", exc_info=True)
+    return out
+
+  aot: Optional[Dict[str, Any]] = None
+  train_prefetcher = None
+  phases: Dict[str, Any] = {}
+  if overlap_startup:
+    if will_train or input_generator_eval is not None:
+      phases["compile"] = _compile_phase
+    if resume_step is not None:
+      phases["restore"] = _restore_phase
+    if will_train:
+      phases["input"] = _input_phase
+  if phases:
+    if resume_step is not None:
+      log.info("Resuming from checkpoint at step %d in %s", resume_step,
+               model_dir)
+    report = orchestrator.run_overlapped(phases)
+    if report.errors:
+      # A failed phase must not leak a sibling's resources: the input
+      # prefetcher pins buffered sharded batches in device memory.
+      orchestrator.close_quietly(report.results.get("input"))
+      metric_logger.close()
+      report.raise_first(order=("restore", "input", "compile"))
+    aot = report.results.get("compile")
+    state = report.results.get("restore", state)
+    train_prefetcher = report.results.get("input")
+    try:
+      report.write(model_dir)
+    except OSError:
+      log.warning("Could not write %s",
+                  orchestrator.STARTUP_TIMINGS_FILE, exc_info=True)
+  elif resume_step is not None:
+    # Serial reference path (overlap_startup=False).
+    log.info("Resuming from checkpoint at step %d in %s", resume_step,
+             model_dir)
+    state = _restore_phase()
+
+  writer = ckpt_lib.CheckpointWriter(
+      model_dir, max_to_keep=max_checkpoints_to_keep)
   # Resume-alignment check BEFORE hooks begin: raising later would
   # leak whatever begin() started past hook_list.end().
   step = int(np.asarray(jax.device_get(state.step)))
   if k > 1 and step % k and step < max_train_steps:
+    if train_prefetcher is not None:
+      train_prefetcher.close()
     writer.close()
     metric_logger.close()
     raise ValueError(
         f"Resumed at step {step}, not a multiple of "
         f"steps_per_dispatch={k}: boundaries would never align.")
-  hook_list.begin(model, model_dir)
+
+  if aot:
+    train_callable = _checked_aot(
+        aot.get("train"), train_step, *aot.get("train_avals", (None, None)),
+        what="train")
+    eval_callable = _checked_aot(
+        aot.get("eval"), eval_step, *aot.get("eval_avals", (None, None)),
+        what="eval")
+  else:
+    train_callable, eval_callable = train_step, eval_step
 
   final_metrics: Dict[str, Any] = {}
-  train_prefetcher = None
   try:
+    # Inside the try: with overlapped startup the prefetcher is
+    # already live, and a hook whose begin() raises must not leak its
+    # worker (the finally below closes it along with writer/logger).
+    hook_list.begin(model, model_dir)
     if input_generator_train is not None and step < max_train_steps:
-      stream = input_generator_train.create_dataset(
-          Mode.TRAIN, batch_size=batch_size)
-      if k > 1:
-        # Finite streams end cleanly mid-stack (the shared helper
-        # swallows the inner StopIteration PEP 479 would otherwise
-        # convert to a RuntimeError, preserving the final
-        # off-interval checkpoint below).
-        stream = prefetch_lib.stack_batches(stream, k)
-        feed_sharding = stacked_sh
-      else:
-        feed_sharding = mesh_lib.batch_sharding(mesh)
-      prefetcher = train_prefetcher = prefetch_lib.ShardedPrefetcher(
-          stream, feed_sharding, buffer_size=2)
+      if train_prefetcher is None:
+        # Serial path (or resume landed short of max_train_steps with
+        # no overlapped input phase): spin up the pipeline here.
+        train_prefetcher = _input_phase()
+      prefetcher = train_prefetcher
       step_rng = jax.random.PRNGKey(seed + 1)
       t_last = time.time()
       steps_since_log = 0
+      # Stall accounting: wall spent in checkpoint saves, interleaved
+      # evals, and metric writes per log interval. `steps_per_sec` is
+      # the PURE train-loop rate (stalls excluded); `stall_fraction`
+      # is the interval's share lost to them — the restart/save
+      # regressions this PR's bench axis watches.
+      stall_secs = 0.0
       last_saved_step = resume_step
       for features, labels in prefetcher:
         if step >= max_train_steps:
           break
         if k == 1:
-          state, metrics = train_step(
+          state, metrics = train_callable(
               state, features, labels,
               jax.random.fold_in(step_rng, step))
         else:
-          state, metrics = train_step(state, features, labels,
-                                      step_rng, np.int32(step))
+          state, metrics = train_callable(state, features, labels,
+                                          step_rng, np.int32(step))
         step += k
         steps_since_log += k
         hook_list.after_step(step, metrics)
@@ -275,11 +475,18 @@ def train_eval_model(
           # One blocking device read per log interval only.
           scalars = jax.device_get(metrics)
           dt = time.time() - t_last
-          scalars["steps_per_sec"] = steps_since_log / max(dt, 1e-9)
-          metric_logger.write("train", step, scalars)
+          scalars["steps_per_sec"] = steps_since_log / max(
+              dt - stall_secs, 1e-9)
+          scalars["stall_fraction"] = min(
+              max(stall_secs / max(dt, 1e-9), 0.0), 1.0)
           final_metrics = scalars
           t_last = time.time()
           steps_since_log = 0
+          t_write = time.perf_counter()
+          metric_logger.write("train", step, scalars)
+          # The write itself is logging stall, charged to the
+          # interval that just began.
+          stall_secs = time.perf_counter() - t_write
 
         if step % save_checkpoints_steps == 0 or step == max_train_steps:
           # Sharded state saves AS-IS: orbax copies device shards to
@@ -288,18 +495,22 @@ def train_eval_model(
           # writes only its addressable shards — a host-side
           # device_get here would block, materialize the unsharded
           # state, and crash on a multi-process pod.
+          t_save = time.perf_counter()
           writer.save(step, state)
           last_saved_step = step
           hook_list.after_checkpoint(step, state, model_dir)
+          stall_secs += time.perf_counter() - t_save
 
         # Interleaved eval runs on its own cadence, independent of the
         # checkpoint interval.
         if (input_generator_eval is not None and eval_every_steps and
             step % eval_every_steps == 0 and step != max_train_steps):
+          t_eval = time.perf_counter()
           eval_metrics = _run_eval(
-              model, eval_step, state, input_generator_eval, mesh,
+              model, eval_callable, state, input_generator_eval, mesh,
               eval_steps, eval_batch_size or batch_size)
           metric_logger.write("eval", step, eval_metrics)
+          stall_secs += time.perf_counter() - t_eval
 
       # Final checkpoint if the loop ended off-interval.
       if last_saved_step != step:
@@ -309,7 +520,7 @@ def train_eval_model(
     # --- final eval ---
     if input_generator_eval is not None:
       eval_metrics = _run_eval(
-          model, eval_step, state, input_generator_eval, mesh,
+          model, eval_callable, state, input_generator_eval, mesh,
           eval_steps, eval_batch_size or batch_size)
       if eval_metrics:
         metric_logger.write("eval", step, eval_metrics)
@@ -348,7 +559,14 @@ def continuous_eval(
 
   Reference parity: the continuous-eval mode of `train_eval_model`
   (SURVEY.md §4.1). Returns {step: metrics} for all evaluated steps.
+
+  Each record carries `restore_secs` / `eval_secs` /
+  `restore_and_eval_secs` — the per-checkpoint wall this evaluator
+  lags the trainer by, i.e. the predictor-side staleness bound: a
+  checkpoint cadence shorter than `restore_and_eval_secs` means this
+  loop permanently falls behind.
   """
+  compile_cache.configure_compilation_cache()
   if mesh is None:
     mesh = mesh_lib.create_mesh()
   input_generator_eval.set_specification_from_model(model, Mode.EVAL)
@@ -367,9 +585,17 @@ def continuous_eval(
           poll_interval_secs=poll_interval_secs)
       if new_step is None:
         break
+      t_restore = time.perf_counter()
       state = ckpt_lib.restore_state(model_dir, like=state, step=new_step)
+      restore_secs = time.perf_counter() - t_restore
+      t_eval = time.perf_counter()
       metrics = _run_eval(model, eval_step, state, input_generator_eval,
                           mesh, eval_steps, eval_batch_size)
+      eval_secs = time.perf_counter() - t_eval
+      metrics = dict(metrics)
+      metrics["restore_secs"] = restore_secs
+      metrics["eval_secs"] = eval_secs
+      metrics["restore_and_eval_secs"] = restore_secs + eval_secs
       metric_logger.write("eval", new_step, metrics)
       results[new_step] = metrics
       last_step = new_step

@@ -76,11 +76,17 @@ class CheckpointWriter:
     self._params_checkpointer = ocp.AsyncCheckpointer(
         ocp.StandardCheckpointHandler())
     self._max_to_keep = max_to_keep
-    self._pending_steps: set = set()
+    # step → payload subdirs still being serialized. Pruned by
+    # completion (orbax's atomic rename makes the payload dir visible
+    # exactly when its async save finishes), NOT only by wait():
+    # otherwise, once the retention window fills, every save() finds
+    # its GC victim "pending" and degrades to a full synchronous wait.
+    self._pending_steps: dict = {}
 
   def save(self, step: int, state: Any, params: Optional[Any] = None,
            batch_stats: Optional[Any] = None, force: bool = False) -> None:
     step_dir = os.path.join(self._root, str(int(step)))
+    payloads = ["state"]
     self._checkpointer.save(
         os.path.join(step_dir, "state"),
         args=ocp.args.StandardSave(state), force=force)
@@ -98,7 +104,8 @@ class CheckpointWriter:
       self._params_checkpointer.save(
           os.path.join(step_dir, "params"),
           args=ocp.args.StandardSave(variables), force=force)
-    self._pending_steps.add(int(step))
+      payloads.append("params")
+    self._pending_steps[int(step)] = payloads
     self._gc()
 
   def wait(self) -> None:
@@ -111,18 +118,37 @@ class CheckpointWriter:
     self._checkpointer.close()
     self._params_checkpointer.close()
 
+  def _step_is_finished(self, step: int) -> bool:
+    """Have all of `step`'s async payloads been finalized on disk?
+
+    Orbax serializes into a tmpdir and atomically renames it to the
+    payload path on commit, so the payload dir existing under its
+    final name IS the completion signal (the same invariant
+    `list_steps` pollers rely on).
+    """
+    step_dir = os.path.join(self._root, str(step))
+    return all(os.path.isdir(os.path.join(step_dir, payload))
+               for payload in self._pending_steps.get(step, ()))
+
+  def _prune_finished(self) -> None:
+    for step in list(self._pending_steps):
+      if self._step_is_finished(step):
+        del self._pending_steps[step]
+
   def _gc(self) -> None:
     if self._max_to_keep is None:
       return
     import shutil
+    self._prune_finished()
     steps = sorted(
         int(e) for e in os.listdir(self._root)
         if re.fullmatch(r"\d+", e))
     excess = len(steps) - self._max_to_keep
     for step in steps[:max(excess, 0)]:
-      # Steady-state deletions target old, long-finished saves; only
-      # block when the victim is still in flight (pathological
-      # max_to_keep < save cadence), so async overlap is preserved.
+      # Steady-state deletions target old, long-finished saves (pruned
+      # above); only block when the victim is genuinely still in
+      # flight (pathological max_to_keep < save cadence), so async
+      # overlap is preserved across an arbitrarily long run.
       if step in self._pending_steps:
         self.wait()
       shutil.rmtree(os.path.join(self._root, str(step)),
