@@ -40,9 +40,16 @@ Usage: python bench.py [--paper] [--profile DIR] [--input] [--replay]
              into DIR (parse with tensor2robot_tpu.utils.xplane).
   --input    measure the tf.data (TFRecord + jpeg decode) host
              pipeline and the pod per-host fan-out verdict.
-  --replay   measure the replay path (ReplayBuffer.sample →
-             ShardedPrefetcher → device) — the feed the north-star
-             QT-Opt loop actually uses.
+  --replay   the replay DATA-PLANE axis (replay_plane section):
+             sample throughput vs shard count (per-shard striped
+             gather), sustained add+sample throughput vs concurrent
+             actor count through the bounded ingestion queue (drop
+             counters recorded), and the measured online staleness
+             histogram. With --dry-run: tiny spec, no
+             BENCH_DETAIL.json write — the tier-1 smoke.
+  --replayfeed  the legacy replay FEED measurement (replay_pipeline
+             section): ReplayBuffer.sample → ShardedPrefetcher →
+             device, the host-rate-vs-chip-rate verdict.
   --longcontext  flash-attention forward + train rates at T=32k
              causal (the long-context serving/training numbers).
   --moe      MoE-transformer train rate vs its dense twin on one
@@ -542,6 +549,294 @@ def bench_replay_pipeline(steps_per_sec: float, batch_size: int = 256,
       "pod_fan_out": _pod_feed_math(host_rate * batch_size,
                                     steps_per_sec),
   }
+
+
+def bench_replay_plane(dry_run: bool = False):
+  """The replay data-plane axis: sharding, actor-fleet ingestion,
+  staleness (tensor2robot_tpu/replay/ — docs/REPLAY.md).
+
+  Three measurements, all host-side (the plane is host memory + locks;
+  the H2D leg is the --replayfeed axis):
+
+    * sample throughput vs SHARD COUNT — uncontended (one sampler, no
+      writers: sharding is bookkeeping overhead here, recorded for
+      honesty; the native gather already stripes rows across cores at
+      any shard count) and UNDER ONLINE LOAD (concurrent sampler
+      threads + a writer thread): per-shard locks are what sharding
+      buys — the 1-shard mutex serializes the writer behind every
+      sampler gather, so the visible scaling on a small host is
+      INGESTION throughput at sample-rate parity, rolled up as total
+      goodput (sampled + committed transitions/sec). A
+      `host_memcpy_2thread_scaling` probe records this host's
+      memory-bandwidth ceiling — the bound on any memcpy-parallelism
+      win (same honesty note as the native-gather story in
+      replay_pipeline: the full win needs the tens of cores a real
+      TPU host has).
+    * sustained add+sample throughput vs CONCURRENT ACTOR COUNT — N
+      producer threads committing episode batches through the bounded
+      ingestion queue (drop-and-count overflow, dropped commits back
+      off the way a real actor's env step paces it) while a sampler
+      thread drains batches, the online-fleet shape; drops recorded.
+    * the ONLINE STALENESS histogram — a simulated learner advances
+      one step per sampled batch while one actor adds concurrently;
+      the fixed-bucket age histogram is the measured form of the
+      round-5 K>1 sampling-lead caveat.
+  """
+  import threading
+
+  from tensor2robot_tpu.replay import (
+      ReplayBatchSampler,
+      ReplayStore,
+      ReplayWriteService,
+  )
+  from tensor2robot_tpu.specs import make_random_tensors
+  from tensor2robot_tpu.utils import native
+
+  if dry_run:
+    from tensor2robot_tpu.research.qtopt import (
+        GraspingQModel,
+        QTOptLearner,
+    )
+    learner = QTOptLearner(GraspingQModel(
+        image_size=16, torso_filters=(8,), head_filters=(8,),
+        dense_sizes=(16,), action_dim=2))
+    fill, batch, sample_batches, trials = 512, 32, 20, 2
+    shard_counts, actor_counts = (1, 2), (1, 2)
+    window_secs, staleness_batches = 0.2, 10
+  else:
+    _, learner, _, _ = build(False)
+    fill, batch, sample_batches, trials = 16384, 256, 100, 5
+    shard_counts, actor_counts = (1, 2, 4, 8), (1, 2, 4)
+    window_secs, staleness_batches = 2.0, 200
+  spec = learner.transition_specification()
+  chunk = make_random_tensors(spec, batch_size=1024, seed=0)
+  chunk_small = make_random_tensors(spec, batch_size=64, seed=1)
+
+  def filled_store(num_shards):
+    store = ReplayStore(spec, capacity=fill, num_shards=num_shards,
+                        seed=0)
+    for i in range(max(1, fill // 1024)):
+      store.add(chunk)
+    return store
+
+  detail = {
+      "config": (f"transition spec of the primary bench model, "
+                 f"fill={fill}, sample batch={batch}"),
+      "host_cores": os.cpu_count(),
+      "native_gather": native.native_available(),
+  }
+
+  # The host's parallel-memcpy ceiling: the hard bound on any
+  # shard-parallelism win for this bandwidth-bound data path.
+  probe = np.random.default_rng(0).integers(
+      0, 255, 16 << 20, dtype=np.uint8)
+  sinks = [np.empty_like(probe) for _ in range(2)]
+  t0 = time.perf_counter()
+  for _ in range(8):
+    np.copyto(sinks[0], probe)
+  one_thread = 8 * probe.nbytes / (time.perf_counter() - t0)
+
+  def _copy(i):
+    for _ in range(8):
+      np.copyto(sinks[i], probe)
+
+  copiers = [threading.Thread(target=_copy, args=(i,))
+             for i in range(2)]
+  t0 = time.perf_counter()
+  for t in copiers:
+    t.start()
+  for t in copiers:
+    t.join()
+  two_thread = 16 * probe.nbytes / (time.perf_counter() - t0)
+  detail["host_memcpy_2thread_scaling"] = {
+      "one_thread_gb_per_sec": round(one_thread / 1e9, 2),
+      "two_thread_aggregate_gb_per_sec": round(two_thread / 1e9, 2),
+      "scaling": round(two_thread / one_thread, 2),
+  }
+
+  # (a) sample throughput vs shard count: uncontended, then under
+  # online load (the regime sharding exists for).
+  n_samplers = max(2, min(4, os.cpu_count() or 2))
+  shard_axis = {}
+  for s in shard_counts:
+    store = filled_store(s)
+    for _ in range(5):
+      store.sample(batch)  # warm caches
+    rates = []
+    for _ in range(trials):
+      t0 = time.perf_counter()
+      for _ in range(sample_batches):
+        store.sample(batch)
+      rates.append(sample_batches / (time.perf_counter() - t0))
+
+    # Loaded: concurrent samplers + a writer hammer the shard locks.
+    # Best of 2 windows (same spread policy as every axis in this
+    # file: a shared 2-core host shows 2-3x run-to-run variance).
+    windows = []
+    for _ in range(2):
+      stop = threading.Event()
+      sampled = [0] * n_samplers
+      added = [0]
+
+      def sample_loop(slot):
+        while not stop.is_set():
+          store.sample(batch)
+          sampled[slot] += 1
+
+      def write_loop():
+        while not stop.is_set():
+          store.add(chunk_small)
+          added[0] += 1
+
+      threads = ([threading.Thread(target=sample_loop, args=(i,))
+                  for i in range(n_samplers)]
+                 + [threading.Thread(target=write_loop)])
+      t0 = time.perf_counter()
+      for t in threads:
+        t.start()
+      time.sleep(window_secs)
+      stop.set()
+      for t in threads:
+        t.join()
+      dt = time.perf_counter() - t0
+      windows.append((sum(sampled) / dt, added[0] * 64 / dt))
+    sample_rate, add_rate = max(
+        windows, key=lambda w: w[0] * batch + w[1])
+    shard_axis[str(s)] = {
+        "uncontended_sample_batches_per_sec": round(max(rates), 2),
+        "uncontended_trials": [round(r, 2) for r in rates],
+        "loaded_sample_batches_per_sec": round(sample_rate, 2),
+        "loaded_add_transitions_per_sec": round(add_rate, 1),
+        "loaded_goodput_transitions_per_sec": round(
+            sample_rate * batch + add_rate, 1),
+        "loaded_windows": [
+            {"sample_batches_per_sec": round(sr, 2),
+             "add_transitions_per_sec": round(ar, 1)}
+            for sr, ar in windows],
+    }
+  base = shard_axis[str(shard_counts[0])]
+  for s in shard_counts:
+    entry = shard_axis[str(s)]
+    for metric in ("loaded_sample_batches_per_sec",
+                   "loaded_add_transitions_per_sec",
+                   "loaded_goodput_transitions_per_sec",
+                   "uncontended_sample_batches_per_sec"):
+      entry[metric.replace("_per_sec", "_speedup_vs_1_shard")] = round(
+          entry[metric] / max(base[metric], 1e-9), 3)
+  detail["sample_throughput_vs_shards"] = {
+      "loaded_config": (f"{n_samplers} sampler threads × batch {batch} "
+                        f"+ 1 writer thread × batch 64, "
+                        f"window {window_secs}s"),
+      "note": (
+          "the data path is memcpy-bound, so every win is capped by "
+          "host_memcpy_2thread_scaling on this host. Two measured "
+          "shard effects: UNCONTENDED sampling speeds up at 2 shards "
+          "(contiguous single-threaded slice gathers beat the 1-shard "
+          "gather's per-call native thread fan-out at this batch "
+          "size; trial ranges don't overlap), and under LOAD sharding "
+          "un-serializes the writer from sampler gathers — add "
+          "throughput scales with shard count while the bandwidth "
+          "ceiling holds total goodput ~flat. Shard counts past the "
+          "core count degrade, which is the docs/REPLAY.md sizing "
+          "rule; the full many-shard win needs the many-core TPU "
+          "host, same story as replay_pipeline.native_note"),
+      **shard_axis,
+  }
+
+  # (b) add+sample under concurrent actors (drop policy: the learner
+  # and the queue must never block on an over-eager fleet).
+  best_shards = max(shard_counts)
+  actor_axis = {}
+  for a in actor_counts:
+    store = filled_store(best_shards)
+    service = ReplayWriteService(store, queue_batches=16,
+                                 overflow="drop")
+    sessions = [service.session(f"bench-actor-{i}") for i in range(a)]
+    stop = threading.Event()
+
+    def produce(sess):
+      while not stop.is_set():
+        if not sess.add(chunk_small):
+          # Dropped commit: back off like a real actor whose env step
+          # paces collection — spinning on a full queue measures GIL
+          # contention, not ingestion capacity.
+          time.sleep(0.002)
+
+    sampled = [0]
+
+    def consume():
+      while not stop.is_set():
+        store.sample(batch)
+        sampled[0] += 1
+
+    threads = ([threading.Thread(target=produce, args=(s,))
+                for s in sessions]
+               + [threading.Thread(target=consume)])
+    adds0 = store.adds_total
+    t0 = time.perf_counter()
+    for t in threads:
+      t.start()
+    time.sleep(window_secs)
+    stop.set()
+    for t in threads:
+      t.join()
+    dt = time.perf_counter() - t0
+    # Snapshot BEFORE flush: the post-window queue drain must not be
+    # attributed to the timed window.
+    committed_in_window = store.adds_total - adds0
+    service.flush()
+    actor_axis[str(a)] = {
+        "committed_transitions_per_sec": round(
+            committed_in_window / dt, 1),
+        "sample_batches_per_sec": round(sampled[0] / dt, 2),
+        "dropped_batches": service.dropped_batches,
+        "drop_fraction": round(
+            service.dropped_batches
+            / max(service.enqueued_batches + service.dropped_batches,
+                  1), 4),
+    }
+    service.close()
+  detail["throughput_vs_actors"] = {
+      "num_shards": best_shards,
+      "producer_batch": 64,
+      "window_secs": window_secs,
+      **actor_axis,
+  }
+
+  # (c) the measured online staleness histogram: learner advances one
+  # step per sampled batch, one actor adds concurrently — the regime
+  # the round-5 caveat described in prose.
+  store = filled_store(best_shards)
+  service = ReplayWriteService(store, queue_batches=16, overflow="drop")
+  session = service.session("staleness-actor")
+  sampler = ReplayBatchSampler(store, batch)
+  stop = threading.Event()
+
+  def produce_staleness():
+    while not stop.is_set():
+      session.add(chunk_small)
+      time.sleep(0.001)
+
+  producer = threading.Thread(target=produce_staleness)
+  producer.start()
+  for step in range(staleness_batches):
+    store.set_learner_step(step)
+    sampler.sample()
+  stop.set()
+  producer.join()
+  service.close()
+  snap = sampler.staleness_snapshot()
+  detail["online_staleness"] = {
+      "learner_steps": staleness_batches,
+      "histogram": snap["histogram"],
+      "mean_age_steps": round(float(snap["mean_age_steps"]), 2),
+      "max_age_steps": snap["max_age_steps"],
+      "note": ("ages in learner steps (sample-time step minus add-time "
+               "step); a pure-offline buffer ages linearly with "
+               "training, an online fleet holds the mean near the "
+               "buffer's refresh half-life"),
+  }
+  return detail
 
 
 def bench_pod_scaling(scan: int = 200):
@@ -1342,6 +1637,23 @@ def main():
     # backend, NO detail-file write.
     print(json.dumps(bench_coldstart(dry_run=True)))
     return
+  if "--replay" in args and "--dry-run" in args:
+    # Tier-1 smoke of the replay data-plane bench path: tiny spec,
+    # small shard/actor axes, NO detail-file write.
+    smoke = bench_replay_plane(dry_run=True)
+    shard_axis = smoke["sample_throughput_vs_shards"]
+    print(json.dumps({
+        "replay_dry_run": "ok",
+        "host_cores": smoke["host_cores"],
+        "shard_counts": sorted(k for k in shard_axis if k.isdigit()),
+        "staleness_rows": sum(
+            smoke["online_staleness"]["histogram"].values()),
+        "dropped_batches_at_max_actors":
+            smoke["throughput_vs_actors"][
+                max(k for k in smoke["throughput_vs_actors"]
+                    if k.isdigit())]["dropped_batches"],
+    }))
+    return
   if "--serving" in args and "--dry-run" in args:
     # Tier-1 smoke of the serving bench path: tiny model, one small
     # bucket table, local backend, NO detail-file write (a CPU smoke
@@ -1360,14 +1672,24 @@ def main():
     profile_dir = args[args.index("--profile") + 1]
   run_paper = "--paper" in args
 
-  # Merge into any existing detail file: a plain run (the driver's)
-  # must not erase the --paper / --input records from a fuller run.
+  # Merge into any existing detail file: a run of ONE axis must never
+  # erase another axis's committed section. Two rules enforce it:
+  # (1) an existing-but-unreadable file ABORTS instead of silently
+  # starting from {} (the clobber path: a truncated file would have
+  # erased every committed axis on the next run); (2) an AXIS-ONLY run
+  # (only axis flags given) reuses the committed `primary` figures for
+  # its verdicts instead of re-measuring — so a CPU-host axis run
+  # cannot overwrite chip-measured headline sections. `--primary`
+  # forces a re-measure alongside axis flags.
   detail = {}
-  try:
-    with open("BENCH_DETAIL.json") as f:
-      detail = json.load(f)
-  except (OSError, ValueError):
-    pass
+  if os.path.exists("BENCH_DETAIL.json"):
+    try:
+      with open("BENCH_DETAIL.json") as f:
+        detail = json.load(f)
+    except ValueError as e:
+      raise SystemExit(
+          "BENCH_DETAIL.json exists but is unreadable; refusing to "
+          f"overwrite committed axes ({e}). Fix or remove it first.")
   # Every bench_config run profiles (to a tempdir when --profile is
   # not given), so top_ops is always fresh from THIS run — the round-4
   # "carried over from a prior profiled run" flag is retired along
@@ -1377,7 +1699,19 @@ def main():
   for section in detail.values():
     if isinstance(section, dict):
       section.pop("top_ops_from_prior_profiled_run", None)
-  detail["primary"] = bench_config(False, profile_dir=profile_dir)
+  detail["version"] = 2  # schema: axis sections merge independently
+  axis_flags = {"--input", "--replay", "--replayfeed", "--longcontext",
+                "--podscale", "--moe", "--pipeline", "--verify",
+                "--serving", "--coldstart", "--mxu"}
+  axis_only = (bool(args) and not run_paper and profile_dir is None
+               and "--primary" not in args
+               and all(a in axis_flags for a in args))
+  if axis_only and "primary" in detail:
+    print(json.dumps({
+        "note": "axis-only run: reusing committed primary figures"}),
+        file=sys.stderr)
+  else:
+    detail["primary"] = bench_config(False, profile_dir=profile_dir)
   if run_paper:
     detail["paper_scale"] = bench_config(
         True, profile_dir=(profile_dir + "_paper")
@@ -1406,6 +1740,8 @@ def main():
         "small-host path (see input_pipeline.decode_scaling)")
     detail["input_pipeline_raw"] = raw
   if "--replay" in args:
+    detail["replay_plane"] = bench_replay_plane()
+  if "--replayfeed" in args:
     detail["replay_pipeline"] = bench_replay_pipeline(steps)
   if "--longcontext" in args:
     detail["long_context"] = bench_long_context()

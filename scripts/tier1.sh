@@ -20,6 +20,11 @@ echo "--- coldstart bench smoke (bench.py --coldstart --dry-run) ---"
 env JAX_PLATFORMS=cpu python bench.py --coldstart --dry-run
 coldstart_rc=$?
 
+echo "--- replay bench smoke (bench.py --replay --dry-run) ---"
+env JAX_PLATFORMS=cpu python bench.py --replay --dry-run
+replay_rc=$?
+
 if [ "$rc" -ne 0 ]; then exit "$rc"; fi
 if [ "$smoke_rc" -ne 0 ]; then exit "$smoke_rc"; fi
-exit "$coldstart_rc"
+if [ "$coldstart_rc" -ne 0 ]; then exit "$coldstart_rc"; fi
+exit "$replay_rc"

@@ -19,7 +19,31 @@ from typing import Any, Iterator, Optional
 import jax
 import numpy as np
 
+from tensor2robot_tpu import config as gin
 from tensor2robot_tpu.specs import TensorSpecStruct
+
+
+@gin.configurable
+def prefetch_buffer_size(buffer_size: Optional[int] = None,
+                         online: bool = False,
+                         offline_default: int = 2,
+                         online_default: int = 1) -> int:
+  """Resolves the `ShardedPrefetcher` lookahead depth (gin tunable).
+
+  Depth trades throughput for sampling lead: each buffered dispatch is
+  a batch sampled BEFORE the steps ahead of it ran, so an ONLINE run
+  (actors feeding replay while the learner trains) pays `depth × K`
+  extra steps of staleness per buffered dispatch. The online default is
+  therefore 1 — the K>1 online sampling-lead finding from round 5 —
+  while offline streams (logged episodes, prefill_random), where sample
+  timing is irrelevant, keep double-buffering. An explicit
+  `buffer_size` (arg or gin) always wins.
+  """
+  if buffer_size is not None:
+    if buffer_size < 1:
+      raise ValueError(f"buffer_size must be >= 1, got {buffer_size}")
+    return int(buffer_size)
+  return int(online_default if online else offline_default)
 
 
 def make_data_sharding(mesh: jax.sharding.Mesh,

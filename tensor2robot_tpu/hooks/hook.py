@@ -14,6 +14,11 @@ from typing import Any, Iterable, Optional
 class Hook:
   """Base hook: override any subset of the callbacks."""
 
+  # Trainers inspect this to detect the ONLINE regime (actors feeding
+  # replay concurrently with training): it changes data-plane defaults
+  # like the prefetch lookahead depth (sampling-lead vs throughput).
+  drives_online_collection: bool = False
+
   def begin(self, model, model_dir: str) -> None:
     """Called once before the first step."""
 
@@ -36,6 +41,11 @@ class HookList(Hook):
 
   def append(self, hook: Hook) -> None:
     self._hooks.append(hook)
+
+  @property
+  def drives_online_collection(self) -> bool:  # type: ignore[override]
+    return any(getattr(h, "drives_online_collection", False)
+               for h in self._hooks)
 
   def begin(self, model, model_dir):
     for h in self._hooks:

@@ -1,0 +1,165 @@
+"""Streaming sampler: store → fixed-wire-spec batches → prefetcher.
+
+The learner-facing edge of the data plane. `ReplayBatchSampler` is an
+infinite iterator of `TensorSpecStruct` batches in the store's wire
+spec — exactly what `data.prefetch.ShardedPrefetcher` consumes — and it
+is where sampling STALENESS becomes a measured quantity: every batch's
+per-row age (learner step at sample minus learner step at add, via the
+store's `set_learner_step` tag) lands in a fixed-bucket histogram the
+trainer logs alongside `stall_fraction`.
+
+Round-5 context: the K>1 online caveat in `train_qtopt` said the last
+step of a dispatch can train on samples up to ~3K parameter updates
+old, and could only say it in prose. With the trainer tagging the store
+each iteration, `staleness_snapshot()` reports the real distribution —
+and the dispatch-depth / K trade-off becomes tunable against data
+instead of a docstring.
+
+The sampler can also record a SCHEDULE DIGEST — a running SHA-256 over
+the exact global row ids drawn — which is what the seeded
+success-protocol reproducibility check compares across runs (two runs
+with the same seeds must produce identical digests).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Dict, Iterator, Optional, Tuple
+
+import numpy as np
+
+from tensor2robot_tpu import config as gin
+from tensor2robot_tpu.replay.store import ReplayStore
+from tensor2robot_tpu.specs import TensorSpecStruct
+
+# Fixed bucket EDGES (upper bounds, in learner steps) so histograms are
+# comparable across runs and JSON-stable; the last bucket is open.
+STALENESS_BUCKETS: Tuple[int, ...] = (
+    0, 1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 4096, 16384)
+
+
+@gin.configurable
+class ReplayBatchSampler:
+  """Infinite fixed-batch sampling stream with staleness accounting."""
+
+  def __init__(self,
+               store: ReplayStore,
+               batch_size: int,
+               record_schedule: bool = False):
+    self._store = store
+    self._batch_size = int(batch_size)
+    self._record_schedule = record_schedule
+    self._digest = hashlib.sha256()
+    self._lock = threading.Lock()
+    self._counts = np.zeros(len(STALENESS_BUCKETS) + 1, np.int64)
+    self._age_sum = 0
+    self._age_max = 0
+    self._rows = 0
+    self._batches = 0
+    # Per-batch mean ages in a fixed RING (not an append-capped list:
+    # that would freeze the "recent" p95 on the run's first window
+    # forever) — 65536 batches of history bounds memory while the p95
+    # tracks the live distribution on long runs.
+    self._recent_means = np.zeros(65536, np.float64)
+    self._recent_count = 0
+
+  @property
+  def batch_size(self) -> int:
+    return self._batch_size
+
+  @property
+  def store(self) -> ReplayStore:
+    return self._store
+
+  @property
+  def wire_spec(self) -> TensorSpecStruct:
+    """The fixed wire spec every emitted batch conforms to."""
+    return self._store.transition_spec
+
+  def sample(self) -> TensorSpecStruct:
+    """One batch; staleness and (optionally) the schedule recorded."""
+    batch, ages, row_ids = self._store.sample_with_ages(self._batch_size)
+    with self._lock:
+      self._counts += np.bincount(
+          np.searchsorted(STALENESS_BUCKETS, ages, side="left"),
+          minlength=len(self._counts))[:len(self._counts)]
+      self._age_sum += int(ages.sum())
+      self._age_max = max(self._age_max, int(ages.max()))
+      self._rows += ages.size
+      self._batches += 1
+      self._recent_means[
+          self._recent_count % self._recent_means.size] = ages.mean()
+      self._recent_count += 1
+      if self._record_schedule:
+        self._digest.update(row_ids.tobytes())
+    return batch
+
+  def __iter__(self) -> Iterator[TensorSpecStruct]:
+    while True:
+      yield self.sample()
+
+  # Alias so the adapter's legacy `as_stream` shape reads naturally.
+  def as_stream(self) -> Iterator[TensorSpecStruct]:
+    return iter(self)
+
+  # ---- reproducibility ----
+
+  def schedule_digest(self) -> str:
+    """SHA-256 over every (shard, slot) drawn so far, in order."""
+    if not self._record_schedule:
+      raise RuntimeError(
+          "schedule recording is off; construct with "
+          "record_schedule=True")
+    with self._lock:
+      return self._digest.hexdigest()
+
+  # ---- staleness reporting ----
+
+  def staleness_snapshot(self) -> Dict[str, object]:
+    """The measured staleness distribution since construction.
+
+    `histogram` maps bucket upper-bound labels ("<=8", ..., ">16384")
+    to sampled-row counts; ages are in LEARNER STEPS (sample-time step
+    minus add-time step), so an offline buffer reads as all-zero ages
+    until training begins and grows linearly after — the online regime
+    is the signal this exists for.
+    """
+    with self._lock:
+      labels = [f"<={b}" for b in STALENESS_BUCKETS] + [
+          f">{STALENESS_BUCKETS[-1]}"]
+      hist = {label: int(c) for label, c in zip(labels, self._counts)}
+      mean = self._age_sum / self._rows if self._rows else 0.0
+      live = self._recent_means[
+          :min(self._recent_count, self._recent_means.size)]
+      p95 = float(np.percentile(live, 95)) if live.size else 0.0
+      return {
+          "histogram": hist,
+          "mean_age_steps": mean,
+          "max_age_steps": self._age_max,
+          "batch_mean_age_p95_steps": p95,
+          "rows": self._rows,
+          "batches": self._batches,
+      }
+
+  def metrics_scalars(self, prefix: str = "replay_") -> Dict[str, float]:
+    """The scalar cut of the snapshot, shaped for the train log."""
+    snap = self.staleness_snapshot()
+    return {
+        f"{prefix}staleness_mean_steps": float(snap["mean_age_steps"]),
+        f"{prefix}staleness_max_steps": float(snap["max_age_steps"]),
+        f"{prefix}staleness_batch_p95_steps": float(
+            snap["batch_mean_age_p95_steps"]),
+        f"{prefix}sampled_batches": float(snap["batches"]),
+    }
+
+
+def make_stream(store: ReplayStore, batch_size: int,
+                record_schedule: bool = False
+                ) -> Tuple[Iterator[TensorSpecStruct],
+                           ReplayBatchSampler]:
+  """(iterator, sampler) — the iterator feeds `ShardedPrefetcher`, the
+  sampler handle stays with the trainer for staleness/metrics reads."""
+  sampler = ReplayBatchSampler(store, batch_size,
+                               record_schedule=record_schedule)
+  return iter(sampler), sampler

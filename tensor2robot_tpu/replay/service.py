@@ -1,0 +1,288 @@
+"""Multi-producer ingestion front: actors → bounded queue → store.
+
+The reference's actor fleet streamed episodes into the replay service
+over RPC; the failure modes that design has to survive are the ones
+this module makes explicit:
+
+  * BACKPRESSURE — producers go through a bounded queue. Policy
+    ``"block"`` applies classic backpressure (an actor's `put` waits
+    for the writer to drain — collection slows to match ingestion);
+    policy ``"drop"`` never blocks a producer: an overflowing batch is
+    counted and discarded (`dropped_batches`/`dropped_transitions`),
+    which is the right trade when fresh on-policy data supersedes stale
+    queued data anyway. The LEARNER is on neither path: sampling reads
+    the store directly and cannot block on ingestion under either
+    policy (pinned by tests/test_replay.py).
+  * ACTOR CRASH — producers write through per-actor SESSIONS that stage
+    an episode locally and commit it atomically at `end_episode`. A
+    crash mid-episode abandons the staged rows; the store never sees a
+    partial episode.
+  * RESTART — re-opening a session under the same `actor_id` aborts
+    whatever the dead incarnation staged (counted in
+    `aborted_episodes`/`restarts`) and resumes ingestion cleanly.
+
+One writer thread drains the queue into `ReplayStore.add` (whole
+batches — one shard lock apiece). A writer-thread error is latched and
+re-raised on `flush()`/`close()` rather than silently killing intake.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from tensor2robot_tpu import config as gin
+from tensor2robot_tpu.replay.store import (
+    ReplayStore,
+    _record_event,
+    to_flat_arrays,
+)
+
+log = logging.getLogger(__name__)
+
+OVERFLOW_POLICIES = ("drop", "block")
+
+
+class _Enqueued:
+  __slots__ = ("flat", "n", "priority")
+
+  def __init__(self, flat: Dict[str, np.ndarray], n: int,
+               priority: Optional[float]):
+    self.flat = flat
+    self.n = n
+    self.priority = priority
+
+
+class ActorIngestSession:
+  """One actor's write handle: episodes stage locally, commit atomically.
+
+  Not thread-safe across actors by design — each actor owns its session
+  (the service hands out one per `actor_id`). `add` is the
+  single-commit convenience for bandit-style envs whose "episode" is
+  one batched step.
+  """
+
+  def __init__(self, service: "ReplayWriteService", actor_id: str):
+    self._service = service
+    self.actor_id = actor_id
+    self._staged: List[Dict[str, np.ndarray]] = []
+    self._in_episode = False
+    self.closed = False
+    self.episodes_committed = 0
+    self.transitions_committed = 0
+
+  def begin_episode(self) -> None:
+    if self._in_episode:
+      # A begin without an end is the crash shape: discard the partial.
+      self.abort()
+    self._in_episode = True
+    self._staged = []
+
+  def append(self, transitions: Any) -> None:
+    """Stages a [N, ...] chunk of the current episode (local only)."""
+    if self.closed:
+      raise RuntimeError(
+          f"session {self.actor_id!r} is closed (actor restarted?)")
+    if not self._in_episode:
+      self.begin_episode()
+    self._staged.append(to_flat_arrays(transitions))
+
+  def end_episode(self, priority: Optional[float] = None) -> bool:
+    """Commits the staged episode through the bounded queue.
+
+    Returns False when the drop policy discarded it (queue full).
+    """
+    if not self._in_episode:
+      return False
+    staged, self._staged = self._staged, []
+    self._in_episode = False
+    if not staged:
+      return False
+    if len(staged) == 1:
+      flat = staged[0]
+    else:
+      flat = {k: np.concatenate([c[k] for c in staged], axis=0)
+              for k in staged[0]}
+    accepted = self._service._enqueue(flat, priority)
+    if accepted:
+      n = int(next(iter(flat.values())).shape[0])
+      self.episodes_committed += 1
+      self.transitions_committed += n
+    return accepted
+
+  def add(self, transitions: Any,
+          priority: Optional[float] = None) -> bool:
+    """begin → append → end in one call (single-step episode batches)."""
+    self.begin_episode()
+    self.append(transitions)
+    return self.end_episode(priority)
+
+  def abort(self) -> None:
+    """Discards any staged partial episode (crash / restart path)."""
+    if self._in_episode or self._staged:
+      self._service._count_abort(self.actor_id)
+    self._staged = []
+    self._in_episode = False
+
+
+@gin.configurable
+class ReplayWriteService:
+  """Bounded-queue ingestion front over a `ReplayStore`."""
+
+  def __init__(self,
+               store: ReplayStore,
+               queue_batches: int = 16,
+               overflow: str = "drop",
+               block_timeout_secs: Optional[float] = None):
+    """Args:
+      store: the sharded store batches drain into.
+      queue_batches: bounded queue depth, in batches.
+      overflow: "drop" (count + discard, producer never blocks) or
+        "block" (backpressure: producer waits for queue space).
+      block_timeout_secs: with "block", an optional cap on the wait —
+        on expiry the batch is dropped and counted (an actor must not
+        hang forever on a wedged writer).
+    """
+    if overflow not in OVERFLOW_POLICIES:
+      raise ValueError(
+          f"overflow must be one of {OVERFLOW_POLICIES}, got {overflow!r}")
+    self._store = store
+    self._overflow = overflow
+    self._block_timeout = block_timeout_secs
+    self._queue: "queue.Queue[_Enqueued]" = queue.Queue(
+        maxsize=queue_batches)
+    self._sessions: Dict[str, ActorIngestSession] = {}
+    self._lock = threading.Lock()
+    self._stop = threading.Event()
+    self._error: Optional[BaseException] = None
+    self.enqueued_batches = 0
+    self.committed_batches = 0
+    self.committed_transitions = 0
+    self.dropped_batches = 0
+    self.dropped_transitions = 0
+    self.aborted_episodes = 0
+    self.restarts = 0
+    self._writer = threading.Thread(
+        target=self._drain, name="replay-writer", daemon=True)
+    self._writer.start()
+
+  @property
+  def store(self) -> ReplayStore:
+    return self._store
+
+  @property
+  def queue_depth(self) -> int:
+    return self._queue.qsize()
+
+  # ---- producer side ----
+
+  def session(self, actor_id: str) -> ActorIngestSession:
+    """The actor's write handle; reopening an id = crash-restart."""
+    with self._lock:
+      prior = self._sessions.pop(actor_id, None)
+    if prior is not None:
+      # Outside the lock: abort() re-enters the service for its
+      # counter (the metrics mutex is not reentrant by design).
+      prior.abort()
+      prior.closed = True
+      with self._lock:
+        self.restarts += 1
+      log.info("replay session %r reopened (actor restart); partial "
+               "state discarded", actor_id)
+    fresh = ActorIngestSession(self, actor_id)
+    with self._lock:
+      self._sessions[actor_id] = fresh
+    return fresh
+
+  def put(self, transitions: Any,
+          priority: Optional[float] = None) -> bool:
+    """Sessionless enqueue of one whole batch (dataset readers)."""
+    return self._enqueue(to_flat_arrays(transitions), priority)
+
+  def _enqueue(self, flat: Dict[str, np.ndarray],
+               priority: Optional[float]) -> bool:
+    if self._error is not None:
+      raise RuntimeError("replay writer thread died") from self._error
+    n = int(next(iter(flat.values())).shape[0])
+    item = _Enqueued(flat, n, priority)
+    try:
+      if self._overflow == "block":
+        self._queue.put(item, timeout=self._block_timeout)
+      else:
+        self._queue.put_nowait(item)
+    except queue.Full:
+      with self._lock:
+        self.dropped_batches += 1
+        self.dropped_transitions += n
+      _record_event("/t2r/replay/drop")
+      return False
+    with self._lock:
+      self.enqueued_batches += 1
+    return True
+
+  def _count_abort(self, actor_id: str) -> None:
+    with self._lock:
+      self.aborted_episodes += 1
+    _record_event("/t2r/replay/abort")
+
+  # ---- writer thread ----
+
+  def _drain(self) -> None:
+    while True:
+      try:
+        item = self._queue.get(timeout=0.05)
+      except queue.Empty:
+        if self._stop.is_set():
+          return
+        continue
+      try:
+        self._store.add(item.flat, priority=item.priority)
+        with self._lock:
+          self.committed_batches += 1
+          self.committed_transitions += item.n
+      except BaseException as e:  # latched; surfaced on flush/close
+        self._error = e
+        log.exception("replay writer failed; ingestion halted")
+        return
+
+  # ---- lifecycle / metrics ----
+
+  def flush(self, timeout_secs: float = 30.0) -> bool:
+    """Blocks until everything enqueued so far has been committed."""
+    deadline = time.monotonic() + timeout_secs
+    while True:
+      if self._error is not None:
+        raise RuntimeError("replay writer thread died") from self._error
+      with self._lock:
+        drained = (self.committed_batches >= self.enqueued_batches
+                   and self._queue.empty())
+      if drained:
+        return True
+      if time.monotonic() > deadline:
+        return False
+      time.sleep(0.005)
+
+  def close(self, timeout_secs: float = 10.0) -> None:
+    self.flush(timeout_secs)
+    self._stop.set()
+    self._writer.join(timeout=timeout_secs)
+    if self._error is not None:
+      raise RuntimeError("replay writer thread died") from self._error
+
+  def metrics_scalars(self, prefix: str = "replay_") -> Dict[str, float]:
+    with self._lock:
+      return {
+          f"{prefix}queue_depth": float(self._queue.qsize()),
+          f"{prefix}enqueued_batches": float(self.enqueued_batches),
+          f"{prefix}committed_transitions": float(
+              self.committed_transitions),
+          f"{prefix}dropped_batches": float(self.dropped_batches),
+          f"{prefix}dropped_transitions": float(self.dropped_transitions),
+          f"{prefix}aborted_episodes": float(self.aborted_episodes),
+          f"{prefix}actor_restarts": float(self.restarts),
+      }

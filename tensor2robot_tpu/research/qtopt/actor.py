@@ -6,21 +6,37 @@ replay service while Bellman updaters trained (SURVEY.md §3 "async
 actor/learner distribution" — the system itself was never
 open-sourced). In-repo TPU-native version: actor THREADS share the
 process with the learner loop — the learner's hot path is device-bound
-(one fused XLA program per step), so host threads are free to run
-envs; the mutex'd `ReplayBuffer` is the meeting point, and the
-policy-state handoff mirrors the reference's checkpoint pull via
-`ActorStateRefreshHook` (actors re-pull the acting params whenever the
-trainer checkpoints).
+(one fused XLA program per step), so host threads are free to run envs.
+
+Two wiring choices per actor, both fleet-shaped:
+
+  * REPLAY SINK — a legacy `ReplayBuffer`/`ReplayStore` (direct `add`)
+    or a `replay.ReplayWriteService` (per-actor session: each collected
+    batch commits as one atomic episode through the bounded ingestion
+    queue, so a crash mid-episode never leaves partial rows and the
+    queue's backpressure/drop policy governs an over-eager fleet).
+  * ACTION SOURCE — a locally-jitted CEM policy (the in-process shape),
+    or a `serving.CEMPolicyServer` (`policy_server=`): actions come
+    through the bucketed AOT engine + micro-batcher, the same serving
+    stack robots use, so N actors coalesce into shared dispatches and
+    the policy-state handoff is the server's lock-free hot-swap.
 
 Exploration: ε-greedy over the CEM policy — each episode acts randomly
-with probability ε, otherwise with the jitted batched CEM argmax.
-Before the first state handoff the actor is purely random, which IS
-the bootstrap phase (replaces `prefill_random`'s spec-random tensors
-with real env transitions).
+with probability ε, otherwise with the CEM argmax. Before the first
+state handoff a local-policy actor is purely random, which IS the
+bootstrap phase (replaces `prefill_random`'s spec-random tensors with
+real env transitions).
+
+Crash/restart: the collection thread catches everything, aborts the
+in-flight session episode, and parks (`crashed` flag + `crash_error`).
+A later `start()` re-opens the session (the service counts the restart
+and discards any stale staged rows) and resumes ingestion — pinned by
+tests/test_replay.py.
 """
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Optional
 
@@ -29,6 +45,8 @@ import numpy as np
 from tensor2robot_tpu import config as gin
 from tensor2robot_tpu.hooks.hook import Hook
 from tensor2robot_tpu.research.qtopt.grasping_env import ToyGraspEnv
+
+log = logging.getLogger(__name__)
 
 
 @gin.configurable
@@ -48,19 +66,33 @@ class GraspActor:
                epsilon: float = 0.1,
                cem_population: Optional[int] = None,
                cem_iterations: Optional[int] = None,
-               seed: int = 0):
+               seed: int = 0,
+               policy_server=None,
+               name: Optional[str] = None):
     import jax
 
     self._learner = learner
     self._replay = replay_buffer
+    self.name = name or f"actor-{seed}"
+    # Sink resolution: a ReplayWriteService hands out per-actor
+    # sessions; anything with .add (ReplayBuffer, ReplayStore, a
+    # session itself) is written to directly.
+    self._service = (replay_buffer
+                     if hasattr(replay_buffer, "session") else None)
+    self._session = (self._service.session(self.name)
+                     if self._service is not None else None)
     self._env = env or ToyGraspEnv(
         image_size=learner.model.image_size,
         action_dim=learner.model.action_dim, seed=seed)
     self._batch = batch_episodes
     self._epsilon = float(epsilon)
-    self._policy = jax.jit(learner.build_policy(
-        cem_population=cem_population,
-        cem_iterations=cem_iterations))
+    self.policy_server = policy_server
+    if policy_server is None:
+      self._policy = jax.jit(learner.build_policy(
+          cem_population=cem_population,
+          cem_iterations=cem_iterations))
+    else:
+      self._policy = None
     self._rng = np.random.default_rng(seed)
     self._jax_key = jax.random.PRNGKey(seed + 1)
     self._state = None
@@ -68,45 +100,87 @@ class GraspActor:
     self._stop = threading.Event()
     self._thread: Optional[threading.Thread] = None
     self.episodes_collected = 0
+    self.episodes_dropped = 0
     self.reward_sum = 0.0
+    self.crashed = False
+    self.crash_error: Optional[BaseException] = None
 
   def update_state(self, state) -> None:
-    """Swaps the acting parameters (called from the trainer thread)."""
+    """Swaps the acting parameters (called from the trainer thread).
+
+    With a policy server attached the state goes to ITS hot-swap (the
+    server must have been constructed with the same acting-state
+    structure — params + BN stats, opt_state stripped); otherwise the
+    local policy's state reference swaps under the lock.
+    """
+    if self.policy_server is not None:
+      self.policy_server.update_state(state)
+      with self._state_lock:
+        self._state = state  # marks bootstrap as over
+      return
     with self._state_lock:
       self._state = state
 
-  def collect_once(self) -> float:
-    """One batch of episodes → replay; returns the batch mean reward."""
+  def _greedy_actions(self, observations, n: int) -> np.ndarray:
+    """CEM actions for the batch via the configured action source."""
     import jax
     from tensor2robot_tpu.specs import TensorSpecStruct
 
-    observations, positions = self._env.reset_batch(self._batch)
+    if self.policy_server is not None:
+      # Through the serving stack: chunk to the engine's max_batch (a
+      # fleet's request sizes all hit pre-compiled buckets).
+      chunk = self.policy_server.engine.max_batch
+      outs = []
+      for lo in range(0, n, chunk):
+        outs.append(self.policy_server.select_actions(
+            {"image": observations["image"][lo:lo + chunk]}))
+      return np.concatenate(outs, axis=0).astype(np.float32)
     with self._state_lock:
       state = self._state
+    self._jax_key, key = jax.random.split(self._jax_key)
+    return np.asarray(jax.device_get(self._policy(
+        state,
+        TensorSpecStruct.from_flat_dict(
+            {"image": observations["image"]}), key))).astype(np.float32)
+
+  def collect_once(self) -> float:
+    """One batch of episodes → replay; returns the batch mean reward."""
+    observations, positions = self._env.reset_batch(self._batch)
     n = self._batch
     random_actions = self._rng.uniform(
         -1, 1, (n, self._env.action_dim)).astype(np.float32)
-    if state is None:
+    with self._state_lock:
+      bootstrapped = self._state is not None
+    if not bootstrapped and self.policy_server is None:
       actions = random_actions
     else:
-      self._jax_key, key = jax.random.split(self._jax_key)
-      actions = np.asarray(jax.device_get(self._policy(
-          state,
-          TensorSpecStruct.from_flat_dict(
-              {"image": observations["image"]}), key)))
+      actions = self._greedy_actions(observations, n)
       explore = self._rng.random(n) < self._epsilon
       actions = np.where(explore[:, None], random_actions,
                          actions).astype(np.float32)
     reward = self._env.grade(actions, positions)
-    self._replay.add({
+    transitions = {
         "image": observations["image"],
         "action": actions,
         "reward": reward[:, None].astype(np.float32),
         "done": np.ones((n, 1), np.float32),
         "next_image": observations["image"],
-    })
-    self.episodes_collected += n
-    self.reward_sum += float(reward.sum())
+    }
+    if self._session is not None:
+      # One collected batch = one atomic episode commit; the service's
+      # overflow policy (drop/block) is the fleet's flow control. A
+      # dropped commit never reached replay, so it must not inflate
+      # episodes_collected (the success-protocol summary reports it).
+      committed = self._session.add(transitions)
+    else:
+      # A bare ActorIngestSession passed as the sink also returns a
+      # drop-policy bool from add(); buffers/stores return None/int.
+      committed = self._replay.add(transitions) is not False
+    if committed:
+      self.episodes_collected += n
+      self.reward_sum += float(reward.sum())
+    else:
+      self.episodes_dropped += n
     return float(reward.mean())
 
   # ---- background-thread lifecycle ----
@@ -115,16 +189,44 @@ class GraspActor:
     """Starts background collection (idempotent — the caller usually
     starts the actor BEFORE train_qtopt so the random bootstrap can
     satisfy min_replay_size, and the refresh hook's begin() is then a
-    no-op)."""
-    if self._thread is not None:
-      return
+    no-op). After a crash, start() RESTARTS: the session is re-opened
+    (stale staged rows discarded, restart counted) and collection
+    resumes."""
+    if self.crashed:
+      # The crashing thread flips `crashed` from INSIDE its except
+      # block, so it can still be mid-exit here — join it before
+      # restarting or an is_alive() check would racily no-op the
+      # restart.
+      if self._thread is not None:
+        self._thread.join(timeout=30.0)
+        if self._thread.is_alive():
+          log.warning("actor %s crash handler still running after 30s "
+                      "join; restart deferred.", self.name)
+          return
+        self._thread = None
+      log.warning("actor %s restarting after crash: %r", self.name,
+                  self.crash_error)
+      self.crashed = False
+      self.crash_error = None
+      if self._service is not None:
+        self._session = self._service.session(self.name)
+    elif self._thread is not None:
+      return  # alive, or cleanly stopped (stop() owns that lifecycle)
     self._stop.clear()
     self._thread = threading.Thread(target=self._run, daemon=True)
     self._thread.start()
 
   def _run(self) -> None:
-    while not self._stop.is_set():
-      self.collect_once()
+    try:
+      while not self._stop.is_set():
+        self.collect_once()
+    except BaseException as e:  # noqa: BLE001 — the crash path IS the point
+      self.crash_error = e
+      self.crashed = True
+      if self._session is not None:
+        self._session.abort()
+      log.exception("actor %s crashed; partial episode discarded",
+                    self.name)
 
   def stop(self) -> None:
     """Stops collection. If the thread is stuck in a long device
@@ -137,8 +239,7 @@ class GraspActor:
     if self._thread is not None:
       self._thread.join(timeout=30.0)
       if self._thread.is_alive():
-        import logging
-        logging.getLogger(__name__).warning(
+        log.warning(
             "actor thread still running after 30s join (likely a "
             "long XLA compile); it will exit at its next loop check.")
         return
@@ -148,7 +249,10 @@ class GraspActor:
 @gin.configurable
 class ActorStateRefreshHook(Hook):
   """Hands each checkpoint's params to the actors — the in-process
-  equivalent of the reference's actors pulling policy checkpoints."""
+  equivalent of the reference's actors pulling policy checkpoints.
+  (Server-wired actors forward the swap to their CEMPolicyServer.)"""
+
+  drives_online_collection = True
 
   def __init__(self, actors):
     self._actors = list(actors) if isinstance(actors, (list, tuple)) \

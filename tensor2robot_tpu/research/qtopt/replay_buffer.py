@@ -1,106 +1,97 @@
-"""Host-side replay buffer streaming transition batches into the mesh.
+"""ReplayBuffer: thin API-compatible adapter over the replay data plane.
 
-The reference's replay buffer was an external Google-infra service
-(SURVEY.md §3 "Async actor/learner distribution" — not open-sourced).
-In-repo TPU-native version: a preallocated numpy ring buffer derived
-mechanically from the transition spec, a uniform sampler, and a stream
-adapter for `ShardedPrefetcher` so sampling/collation overlaps device
-compute — the host never appears in the jitted hot loop.
+Through round 5 this module WAS the replay system — a single-process
+numpy ring buffer. The sharded store / ingestion service / streaming
+sampler now live in `tensor2robot_tpu/replay/`; this class keeps the
+old call surface (`add` / `sample` / `as_stream` / `wait_until_size`)
+so every existing caller and gin config keeps working, delegating to a
+`ReplayStore` underneath.
 
-Throughput notes:
-  * storage is spec-dtype (uint8 images stay uint8 → 4× less host RAM
-    and 4× less H2D traffic than float storage),
-  * `sample()` is one `rng.integers` + one row gather per key — no
-    per-example python. The gather runs through the native C++ module
-    (`native/gather.cc`, threaded memcpy striped across cores) when
-    the library builds, since numpy's fancy indexing is
-    single-threaded and TPU hosts have tens of cores per chip;
-    otherwise numpy, bit-identical,
-  * writers (env actors / dataset readers) and the sampling reader are
-    decoupled by a mutex; adds are batched (threaded scatter, same
-    module).
+Compatibility contract (pinned by tests/test_replay.py): with the
+defaults (one shard, uniform sampling) the adapter is BIT-IDENTICAL to
+the legacy buffer — same seeded rng call per sample, same physical row
+layout, same gather — so a training run through it reproduces the old
+in-process path exactly. The new capabilities (shards, prioritized/FIFO
+sampling, eviction spill, staleness metrics) are opt-in constructor
+args and passthroughs.
 """
 
 from __future__ import annotations
 
-import threading
-from typing import Dict, Iterator, Optional, Tuple
-
-import numpy as np
+from typing import Dict, Iterator, Optional
 
 from tensor2robot_tpu import config as gin
-from tensor2robot_tpu import specs as specs_lib
+from tensor2robot_tpu.replay import ReplayBatchSampler, ReplayStore
 from tensor2robot_tpu.specs import TensorSpecStruct
-from tensor2robot_tpu.utils import native
 
 
 @gin.configurable
 class ReplayBuffer:
-  """Uniform-sampling ring buffer over a flat transition spec."""
+  """Uniform-sampling ring buffer API over the sharded `ReplayStore`."""
 
   def __init__(self, transition_spec: TensorSpecStruct,
-               capacity: int = 100_000, seed: int = 0):
-    self._spec = specs_lib.flatten_spec_structure(transition_spec)
-    self._capacity = int(capacity)
-    self._storage: Dict[str, np.ndarray] = {}
-    for key, spec in self._spec.to_flat_dict().items():
-      self._storage[key] = np.zeros(
-          (self._capacity,) + tuple(spec.shape), dtype=spec.dtype)
-    self._lock = threading.Lock()
-    self._rng = np.random.default_rng(seed)
-    self._insert_index = 0
-    self._size = 0
+               capacity: int = 100_000, seed: int = 0,
+               num_shards: int = 1, sampling: str = "uniform",
+               spill_dir: Optional[str] = None):
+    self._store = ReplayStore(
+        transition_spec, capacity=capacity, num_shards=num_shards,
+        seed=seed, sampling=sampling, spill_dir=spill_dir)
+    self._stream_sampler: Optional[ReplayBatchSampler] = None
 
   def __len__(self) -> int:
-    return self._size
+    return len(self._store)
 
   @property
   def capacity(self) -> int:
-    return self._capacity
+    return self._store.capacity
 
-  def add(self, transitions: TensorSpecStruct) -> None:
+  @property
+  def store(self) -> ReplayStore:
+    """The underlying data-plane store (service attachment point)."""
+    return self._store
+
+  def add(self, transitions: TensorSpecStruct,
+          priority: Optional[float] = None) -> None:
     """Appends a BATCH of transitions (dict/struct of [N, ...] arrays)."""
-    flat = (transitions.to_flat_dict()
-            if isinstance(transitions, TensorSpecStruct)
-            else dict(transitions))
-    n = next(iter(flat.values())).shape[0]
-    if n > self._capacity:
-      flat = {k: v[-self._capacity:] for k, v in flat.items()}
-      n = self._capacity
-    with self._lock:
-      start = self._insert_index
-      idx = (start + np.arange(n)) % self._capacity
-      for key, store in self._storage.items():
-        if key not in flat:
-          raise KeyError(f"Transition batch missing key {key!r}.")
-        native.scatter_rows(store, idx,
-                            np.ascontiguousarray(flat[key]))
-      self._insert_index = int((start + n) % self._capacity)
-      self._size = int(min(self._size + n, self._capacity))
+    self._store.add(transitions, priority=priority)
 
   def sample(self, batch_size: int) -> TensorSpecStruct:
-    """Uniform random batch; one vectorized (threaded) gather per key."""
-    with self._lock:
-      if self._size == 0:
-        raise ValueError("Cannot sample from an empty replay buffer.")
-      idx = self._rng.integers(0, self._size, size=batch_size)
-      out = {key: native.gather_rows(store, idx)
-             for key, store in self._storage.items()}
-    return TensorSpecStruct.from_flat_dict(out)
+    """Seeded random batch (empty buffer raises, as before)."""
+    try:
+      return self._store.sample(batch_size)
+    except ValueError as e:
+      # Legacy message said "replay buffer"; keep tests/callers happy.
+      raise ValueError(
+          "Cannot sample from an empty replay buffer.") from e
 
   def as_stream(self, batch_size: int) -> Iterator[TensorSpecStruct]:
-    """Infinite sampling stream (feeds ShardedPrefetcher)."""
-    while True:
-      yield self.sample(batch_size)
+    """Infinite sampling stream (feeds ShardedPrefetcher).
+
+    The stream's sampler handle is kept so `metrics_scalars` /
+    `staleness_snapshot` report the live training stream's staleness.
+    """
+    self._stream_sampler = ReplayBatchSampler(self._store, batch_size)
+    return iter(self._stream_sampler)
 
   def wait_until_size(self, min_size: int,
                       timeout_secs: Optional[float] = None) -> bool:
     """Blocks until `min_size` transitions are buffered (actor warmup)."""
-    import time
-    deadline = (time.time() + timeout_secs) if timeout_secs is not None \
-        else None
-    while self._size < min_size:
-      if deadline is not None and time.time() > deadline:
-        return False
-      time.sleep(0.01)
-    return True
+    return self._store.wait_until_size(min_size, timeout_secs)
+
+  # ---- data-plane passthroughs (new capability, optional to use) ----
+
+  def set_learner_step(self, step: int) -> None:
+    """Tags subsequent adds with the learner step (staleness source)."""
+    self._store.set_learner_step(step)
+
+  def metrics_scalars(self, prefix: str = "replay_") -> Dict[str, float]:
+    """Store fill/throughput + stream staleness, for the train log."""
+    out = self._store.metrics_scalars(prefix=prefix)
+    if self._stream_sampler is not None:
+      out.update(self._stream_sampler.metrics_scalars(prefix=prefix))
+    return out
+
+  def staleness_snapshot(self) -> Optional[Dict[str, object]]:
+    if self._stream_sampler is None:
+      return None
+    return self._stream_sampler.staleness_snapshot()
