@@ -38,8 +38,14 @@ Usage: python bench.py [--paper] [--profile DIR] [--input] [--replay]
              depth stack) — slower; always summarized in detail file.
   --profile  capture a jax.profiler trace of primary-config steps
              into DIR (parse with tensor2robot_tpu.utils.xplane).
-  --input    measure the tf.data (TFRecord + jpeg decode) host
-             pipeline and the pod per-host fan-out verdict.
+  --input    the host input-plane axis: in-process tf.data (TFRecord
+             + jpeg/raw decode) rate AND the process-parallel data
+             plane's worker-scaling curve (1→N workers through the
+             shm ring, zero-copy consumer), with the host memcpy/core
+             ceiling recorded and the pod per-host fan-out verdicts
+             recomputed from the best measured rate. With --dry-run:
+             tiny records, one worker, no BENCH_DETAIL.json write —
+             the tier-1 smoke.
   --replay   the replay DATA-PLANE axis (replay_plane section):
              sample throughput vs shard count (per-shard striped
              gather), sustained add+sample throughput vs concurrent
@@ -447,8 +453,10 @@ def bench_jpeg_decode_scaling(required_items_per_sec: float,
           required_items_per_sec, 1),
       "jpeg_cores_needed_for_pod_per_host": round(cores_needed, 2),
       "verdict": (
-          "jpeg decode is core-bound (2-process aggregate ≈ "
-          "1-process on this 1-core rig); at the full pipeline's "
+          f"jpeg decode is core-bound (2-process aggregate = "
+          f"{two_proc_aggregate / one_proc:.2f}× 1-process on this "
+          f"{os.cpu_count()}-core rig — process parallelism buys only "
+          "what spare cores exist); at the full pipeline's "
           f"measured per-core rate a pod host needs "
           f"~{cores_needed:.1f} cores for the per-host requirement — "
           "arithmetic from measured rates, not a feeds claim "
@@ -551,6 +559,47 @@ def bench_replay_pipeline(steps_per_sec: float, batch_size: int = 256,
   }
 
 
+def _host_memcpy_scaling(threads: int = 0):
+  """The host's parallel-memcpy ceiling: the hard bound on any
+  memcpy-parallelism win for a bandwidth-bound data path (shared by
+  the replay-plane and input-plane axes — the honesty record that
+  bounds their scaling claims on this host).
+
+  Probes with one thread and with `threads` (default: cpu_count capped
+  at 8 — a fixed 2-thread probe would saturate near 2.0 and UNDERSTATE
+  the ceiling on many-core hosts, turning the recorded "bound" into a
+  number the same file's worker rows could legitimately exceed)."""
+  import threading
+
+  threads = threads or min(os.cpu_count() or 2, 8)
+  probe = np.random.default_rng(0).integers(
+      0, 255, 16 << 20, dtype=np.uint8)
+  sinks = [np.empty_like(probe) for _ in range(threads)]
+  t0 = time.perf_counter()
+  for _ in range(8):
+    np.copyto(sinks[0], probe)
+  one_thread = 8 * probe.nbytes / (time.perf_counter() - t0)
+
+  def _copy(i):
+    for _ in range(8):
+      np.copyto(sinks[i], probe)
+
+  copiers = [threading.Thread(target=_copy, args=(i,))
+             for i in range(threads)]
+  t0 = time.perf_counter()
+  for t in copiers:
+    t.start()
+  for t in copiers:
+    t.join()
+  aggregate = threads * 8 * probe.nbytes / (time.perf_counter() - t0)
+  return {
+      "threads": threads,
+      "one_thread_gb_per_sec": round(one_thread / 1e9, 2),
+      "aggregate_gb_per_sec": round(aggregate / 1e9, 2),
+      "scaling": round(aggregate / one_thread, 2),
+  }
+
+
 def bench_replay_plane(dry_run: bool = False):
   """The replay data-plane axis: sharding, actor-fleet ingestion,
   staleness (tensor2robot_tpu/replay/ — docs/REPLAY.md).
@@ -567,7 +616,7 @@ def bench_replay_plane(dry_run: bool = False):
       sampler gather, so the visible scaling on a small host is
       INGESTION throughput at sample-rate parity, rolled up as total
       goodput (sampled + committed transitions/sec). A
-      `host_memcpy_2thread_scaling` probe records this host's
+      `host_memcpy_scaling` probe records this host's
       memory-bandwidth ceiling — the bound on any memcpy-parallelism
       win (same honesty note as the native-gather story in
       replay_pipeline: the full win needs the tens of cores a real
@@ -625,34 +674,7 @@ def bench_replay_plane(dry_run: bool = False):
       "host_cores": os.cpu_count(),
       "native_gather": native.native_available(),
   }
-
-  # The host's parallel-memcpy ceiling: the hard bound on any
-  # shard-parallelism win for this bandwidth-bound data path.
-  probe = np.random.default_rng(0).integers(
-      0, 255, 16 << 20, dtype=np.uint8)
-  sinks = [np.empty_like(probe) for _ in range(2)]
-  t0 = time.perf_counter()
-  for _ in range(8):
-    np.copyto(sinks[0], probe)
-  one_thread = 8 * probe.nbytes / (time.perf_counter() - t0)
-
-  def _copy(i):
-    for _ in range(8):
-      np.copyto(sinks[i], probe)
-
-  copiers = [threading.Thread(target=_copy, args=(i,))
-             for i in range(2)]
-  t0 = time.perf_counter()
-  for t in copiers:
-    t.start()
-  for t in copiers:
-    t.join()
-  two_thread = 16 * probe.nbytes / (time.perf_counter() - t0)
-  detail["host_memcpy_2thread_scaling"] = {
-      "one_thread_gb_per_sec": round(one_thread / 1e9, 2),
-      "two_thread_aggregate_gb_per_sec": round(two_thread / 1e9, 2),
-      "scaling": round(two_thread / one_thread, 2),
-  }
+  detail["host_memcpy_scaling"] = _host_memcpy_scaling()
 
   # (a) sample throughput vs shard count: uncontended, then under
   # online load (the regime sharding exists for).
@@ -729,7 +751,7 @@ def bench_replay_plane(dry_run: bool = False):
                         f"window {window_secs}s"),
       "note": (
           "the data path is memcpy-bound, so every win is capped by "
-          "host_memcpy_2thread_scaling on this host. Two measured "
+          "host_memcpy_scaling on this host. Two measured "
           "shard effects: UNCONTENDED sampling speeds up at 2 shards "
           "(contiguous single-threaded slice gathers beat the 1-shard "
           "gather's per-call native thread fan-out at this batch "
@@ -1133,15 +1155,25 @@ def bench_verify_numerics():
   # is catching LOWERING divergences — mask/block/layout bugs produce
   # O(0.1–1) errors, orders above these bars; exactness of the math
   # is separately pinned by the interpret-mode CPU suite.
+  #
+  # dv gate: the ~4e-2 dv errors the first runs measured carried TWO
+  # avoidable MXU relayout passes of the per-row lse (forward
+  # identity-transpose to lanes, backward 1/8-contraction back to
+  # sublanes — the round-5 advisor finding). The lse now stays
+  # sublane-major end to end with no matmul touching it, so dv's
+  # remaining error sources are the same score/PV contractions dq/dk
+  # pay and its gate drops to their 5e-2 bar (was 1.5e-1).
   results["precision_note"] = (
       "flash thresholds sized to MXU f32-emulation epsilon (~bf16 "
-      "per contraction); interpret-mode tests pin exactness at 1e-6")
+      "per contraction); interpret-mode tests pin exactness at 1e-6; "
+      "lse/delta stay sublane-major (no MXU relayout), so dv shares "
+      "the dq/dk bar")
   results["hardware_numerics_ok"] = bool(
       results["flash_forward_max_err"] < 2e-2
       and results["flash_lse_max_err"] < 5e-2
       and results["flash_backward_dq_max_err"] < 5e-2
       and results["flash_backward_dk_max_err"] < 5e-2
-      and results["flash_backward_dv_max_err"] < 1.5e-1
+      and results["flash_backward_dv_max_err"] < 5e-2
       and results["cem_head_max_err"] < 5e-2
       and results["qtopt_step_loss_tpu_vs_cpu_rel_err"] < 1e-2
       and results["qtopt_step_gradnorm_tpu_vs_cpu_rel_err"] < 1e-2)
@@ -1572,25 +1604,15 @@ def _bench_savedmodel_host_latency(calls: int = 100):
   return out
 
 
-def bench_input_pipeline(batch_size: int = 256, image_size: int = 64,
-                         num_records: int = 2048, batches: int = 40,
-                         image_format: str = "jpeg"):
-  """Host tf.data pipeline rate at the bench config.
+def _write_bench_records(tmp: str, image_size: int, image_format: str,
+                         num_records: int, num_files: int = 8):
+  """Seeds `num_files` TFRecord shards + the spec for the input bench.
 
-  The question the number answers: can ONE host feed one chip's
-  measured Bellman-step rate at the bench batch size? (SURVEY §4.3 —
-  parse + decode run inside the tf.data graph under AUTOTUNE.)
-  `image_format="raw"` measures the decode_raw wire (disk-for-CPU
-  trade) against the same pipeline, isolating the codec cost.
+  Multiple files matter now: data-plane workers shard the FILE LIST,
+  so a single-file dataset would serialize any worker count onto one
+  worker.
   """
-  import os
-  import tempfile
-
-  import tensorflow as tf  # noqa: F401 — required for the pipeline
-
-  from tensor2robot_tpu.data.abstract_input_generator import Mode
   from tensor2robot_tpu.data.tfrecord_input_generator import (
-      TFRecordInputGenerator,
       write_tfrecord,
   )
   from tensor2robot_tpu.specs import ExtendedTensorSpec, TensorSpecStruct
@@ -1602,30 +1624,147 @@ def bench_input_pipeline(batch_size: int = 256, image_size: int = 64,
   spec.action = ExtendedTensorSpec(shape=(4,), dtype=np.float32,
                                    name="action")
   rng = np.random.default_rng(0)
-  with tempfile.TemporaryDirectory() as tmp:
-    path = os.path.join(tmp, "bench.tfrecord")
+  per_file = num_records // num_files
+  for f in range(num_files):
     write_tfrecord(
-        path,
+        os.path.join(tmp, f"bench-{f:02d}.tfrecord"),
         [{"image": rng.integers(0, 255, (image_size, image_size, 3)
                                 ).astype(np.uint8),
           "action": rng.standard_normal(4).astype(np.float32)}
-         for _ in range(num_records)],
+         for _ in range(per_file)],
         spec)
-    gen = TFRecordInputGenerator(
-        file_patterns=path, batch_size=batch_size,
-        shuffle_buffer_size=num_records, seed=0)
-    gen.set_specification(spec, None)
-    it = gen.create_dataset(Mode.TRAIN)
-    next(it)  # warm the pipeline
-    t0 = time.perf_counter()
-    for _ in range(batches):
+  return spec, os.path.join(tmp, "bench-*.tfrecord")
+
+
+def _time_input_stream(spec, pattern, batch_size: int,
+                       num_records: int, batches: int,
+                       num_workers: int, trials: int = 3):
+  """(best, trial list, cores_used) of one generator config.
+
+  Best-of-N windows, same spread policy as every axis in this file: a
+  shared/degraded 2-core host shows 2-3× run-to-run variance, and max
+  throughput reflects machine capability. Warmup (plane spawn +
+  imports, tf.data AUTOTUNE ramp) is excluded from every window —
+  including the CPU-seconds-per-wall measurement (`cores_used`, this
+  process only), which must cover exactly the windows the rates come
+  from or warmup CPU inflates it and deflates the derived headroom
+  bound.
+  """
+  from tensor2robot_tpu.data.abstract_input_generator import Mode
+  from tensor2robot_tpu.data.tfrecord_input_generator import (
+      TFRecordInputGenerator,
+  )
+
+  gen = TFRecordInputGenerator(
+      file_patterns=pattern, batch_size=batch_size,
+      shuffle_buffer_size=num_records, seed=0,
+      num_workers=num_workers,
+      # Zero-copy consumer views: the deployment consumer shape (on
+      # TPU/GPU the H2D DMA copies; the CPU-backend copy fallback is
+      # a jax aliasing workaround, not part of the plane's rate).
+      plane_copy=False)
+  gen.set_specification(spec, None)
+  it = gen.create_dataset(Mode.TRAIN)
+  try:
+    for _ in range(6):  # warm: spawn/imports, AUTOTUNE ramp, caches
       next(it)
-    rate = batches / (time.perf_counter() - t0)
+    rates = []
+    cpu0, tw0 = os.times(), time.perf_counter()
+    for _ in range(trials):
+      t0 = time.perf_counter()
+      for _ in range(batches):
+        next(it)
+      rates.append(batches / (time.perf_counter() - t0))
+    cpu1, trial_wall = os.times(), time.perf_counter() - tw0
+    cores_used = ((cpu1.user + cpu1.system)
+                  - (cpu0.user + cpu0.system)) / max(trial_wall, 1e-9)
+    return max(rates), rates, cores_used
+  finally:
+    closer = getattr(it, "close", None)
+    if closer is not None:
+      closer()
+
+
+def bench_input_pipeline(batch_size: int = 256, image_size: int = 64,
+                         num_records: int = 2048, batches: int = 40,
+                         image_format: str = "jpeg",
+                         worker_counts=(1, 2, 4)):
+  """Host input rate: in-process tf.data vs the process-parallel plane.
+
+  The question the numbers answer: can ONE host feed one chip's
+  measured Bellman-step rate at the bench batch size? (SURVEY §4.3 —
+  parse + decode run inside the tf.data graph under AUTOTUNE.) The
+  in-process pipeline caps near one core of decode (and
+  `decode_scaling` shows in-process/threaded parallelism can't fix it:
+  GIL + TF intra-op contention), so this bench also measures the
+  WORKER-SCALING curve of `TFRecordInputGenerator(num_workers=N)` —
+  the shm-ring data plane of `data/plane.py` — with the host's
+  memcpy-scaling ceiling and core count recorded as the explicit
+  bound on any parallel-decode win (a 2-core rig cannot demonstrate a
+  16-core host's curve; the per-worker rate and the ceiling are the
+  honest transferable facts). `image_format="raw"` measures the
+  decode_raw wire (disk-for-CPU trade) against the same pipeline,
+  isolating the codec cost. `feeds_chip`/`pod_fan_out` verdicts use
+  the BEST measured rate across worker counts.
+  """
+  import tempfile
+
+  import tensorflow as tf  # noqa: F401 — required for the pipeline
+
+  with tempfile.TemporaryDirectory() as tmp:
+    spec, pattern = _write_bench_records(
+        tmp, image_size, image_format, num_records)
+    # CPU-seconds-per-wall across the in-process TIMED windows (warmup
+    # excluded, matching the rate windows): how many cores AUTOTUNE
+    # already consumes with zero workers — the spare cores (vs
+    # host_memcpy_scaling's effective-parallelism ceiling) are all the
+    # plane can possibly add on this host.
+    rate, base_trials, in_process_cores = _time_input_stream(
+        spec, pattern, batch_size, num_records, batches, num_workers=0)
+    scaling = {"0": {"batches_per_sec": round(rate, 2),
+                     "images_per_sec": round(rate * batch_size, 1),
+                     "trials": [round(r, 2) for r in base_trials]}}
+    best_rate, best_workers = rate, 0
+    for w in worker_counts:
+      w_rate, w_trials, _ = _time_input_stream(
+          spec, pattern, batch_size, num_records, batches,
+          num_workers=w)
+      scaling[str(w)] = {
+          "batches_per_sec": round(w_rate, 2),
+          "images_per_sec": round(w_rate * batch_size, 1),
+          "trials": [round(r, 2) for r in w_trials],
+          "speedup_vs_in_process": round(w_rate / max(rate, 1e-9), 3),
+      }
+      if w_rate > best_rate:
+        best_rate, best_workers = w_rate, w
+  cores = os.cpu_count()
   return {
       "config": (f"batch={batch_size}, {image_size}x{image_size} "
-                 f"{image_format} decode in tf.data graph (AUTOTUNE)"),
+                 f"{image_format} decode in tf.data graph (AUTOTUNE); "
+                 f"worker rows = data-plane processes (shm ring, "
+                 f"zero-copy consumer views)"),
       "batches_per_sec": round(rate, 2),
       "images_per_sec": round(rate * batch_size, 1),
+      "worker_scaling": scaling,
+      "best_num_workers": best_workers,
+      "best_batches_per_sec": round(best_rate, 2),
+      "best_images_per_sec": round(best_rate * batch_size, 1),
+      "host_cores": cores,
+      "in_process_cores_used": round(in_process_cores, 2),
+      "scaling_note": (
+          f"in-process AUTOTUNE already consumes "
+          f"{in_process_cores:.2f} cores of this {cores}-core host "
+          "(in_process_cores_used), and "
+          "host_memcpy_scaling records the host's measured "
+          "effective-parallelism ceiling — the plane can only win "
+          "what spare parallel capacity exists between those two "
+          "numbers, so on a saturated small host the worker curve "
+          "reads as the IPC overhead floor, not the plane's ceiling. "
+          "The transferable capacity estimate for a many-core TPU "
+          "host is the per-worker rate × spare decode cores "
+          "(file shards decompose linearly; see "
+          "input_pipeline.decode_scaling for the per-core decode "
+          "arithmetic and docs/DATA.md for the sizing rule)."),
   }
 
 
@@ -1652,6 +1791,24 @@ def main():
             smoke["throughput_vs_actors"][
                 max(k for k in smoke["throughput_vs_actors"]
                     if k.isdigit())]["dropped_batches"],
+    }))
+    return
+  if "--input" in args and "--dry-run" in args:
+    # Tier-1 smoke of the input data-plane bench path: tiny records,
+    # one worker, NO detail-file write — exercises record writing, the
+    # in-process pipeline, plane spawn/stream/close, and the scaling
+    # bookkeeping end to end on CPU.
+    smoke = bench_input_pipeline(batch_size=32, image_size=16,
+                                 num_records=256, batches=8,
+                                 worker_counts=(1,))
+    print(json.dumps({
+        "input_dry_run": "ok",
+        "host_cores": smoke["host_cores"],
+        "in_process_images_per_sec": smoke["images_per_sec"],
+        "worker_1_images_per_sec":
+            smoke["worker_scaling"]["1"]["images_per_sec"],
+        "worker_1_speedup":
+            smoke["worker_scaling"]["1"]["speedup_vs_in_process"],
     }))
     return
   if "--serving" in args and "--dry-run" in args:
@@ -1719,22 +1876,51 @@ def main():
     detail["paper_scale_mxu_width"] = bench_config(True, width=128)
   steps = detail["primary"]["steps_per_sec_best"]
   if "--input" in args:
-    detail["input_pipeline"] = bench_input_pipeline()
-    detail["input_pipeline"]["feeds_chip"] = bool(
-        detail["input_pipeline"]["batches_per_sec"] >= steps)
-    detail["input_pipeline"]["pod_fan_out"] = _pod_feed_math(
-        detail["input_pipeline"]["images_per_sec"], steps)
+    # Both wires measure the in-process baseline AND the data-plane
+    # worker-scaling curve; feed verdicts use the BEST measured rate,
+    # with the host memcpy ceiling + core count recorded as the bound
+    # on what a small rig can demonstrate (docs/DATA.md).
+    memcpy_ceiling = _host_memcpy_scaling()
+
+    def _plane_headroom(section):
+      # The PR-3-style explicit bound: the host's measured parallel
+      # capacity (memcpy n-thread scaling ≈ effective parallel
+      # throughput in units of one thread) over what the in-process
+      # pipeline already consumes. Arithmetic from measured rates,
+      # not a feeds claim — a bound ≤ ~1.2 says the worker curve on
+      # this host measures IPC overhead, not the plane's ceiling.
+      return {
+          "max_speedup_vs_in_process": round(
+              memcpy_ceiling["scaling"]
+              / max(section["in_process_cores_used"], 1e-9), 2),
+          "note": ("arithmetic bound: host_memcpy_scaling / "
+                   "in_process_cores_used; the plane's scaling claim "
+                   "transfers via per-worker rate × spare cores, "
+                   "verified on the deployment host by "
+                   "input_wait_fraction (docs/DATA.md)"),
+      }
+
+    jpeg = bench_input_pipeline()
+    jpeg["host_memcpy_scaling"] = memcpy_ceiling
+    jpeg["plane_headroom_bound_this_host"] = _plane_headroom(jpeg)
+    jpeg["feeds_chip"] = bool(jpeg["best_batches_per_sec"] >= steps)
+    jpeg["pod_fan_out"] = _pod_feed_math(
+        jpeg["best_images_per_sec"], steps)
     # Evidence for the decode-CPU story (round-4 verdict item 7):
     # per-core decode rate + 2-process scaling on this rig, and the
-    # pod question reduced to core-count arithmetic.
-    detail["input_pipeline"]["decode_scaling"] = (
-        bench_jpeg_decode_scaling(
-            detail["input_pipeline"]["pod_fan_out"]
-            ["per_host_required_items_per_sec"],
-            detail["input_pipeline"]["images_per_sec"]))
+    # pod question reduced to core-count arithmetic (per-core rate =
+    # the in-process pipeline; the plane multiplies cores, not the
+    # per-core rate).
+    jpeg["decode_scaling"] = bench_jpeg_decode_scaling(
+        jpeg["pod_fan_out"]["per_host_required_items_per_sec"],
+        jpeg["images_per_sec"])
+    detail["input_pipeline"] = jpeg
     raw = bench_input_pipeline(image_format="raw")
-    raw["feeds_chip"] = bool(raw["batches_per_sec"] >= steps)
-    raw["pod_fan_out"] = _pod_feed_math(raw["images_per_sec"], steps)
+    raw["host_memcpy_scaling"] = memcpy_ceiling
+    raw["plane_headroom_bound_this_host"] = _plane_headroom(raw)
+    raw["feeds_chip"] = bool(raw["best_batches_per_sec"] >= steps)
+    raw["pod_fan_out"] = _pod_feed_math(raw["best_images_per_sec"],
+                                        steps)
     raw["pod_fan_out"]["note"] = (
         "raw wire is the measured pod-scale default; jpeg is the "
         "small-host path (see input_pipeline.decode_scaling)")

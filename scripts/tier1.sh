@@ -24,7 +24,12 @@ echo "--- replay bench smoke (bench.py --replay --dry-run) ---"
 env JAX_PLATFORMS=cpu python bench.py --replay --dry-run
 replay_rc=$?
 
+echo "--- input bench smoke (bench.py --input --dry-run) ---"
+env JAX_PLATFORMS=cpu python bench.py --input --dry-run
+input_rc=$?
+
 if [ "$rc" -ne 0 ]; then exit "$rc"; fi
 if [ "$smoke_rc" -ne 0 ]; then exit "$smoke_rc"; fi
 if [ "$coldstart_rc" -ne 0 ]; then exit "$coldstart_rc"; fi
-exit "$replay_rc"
+if [ "$replay_rc" -ne 0 ]; then exit "$replay_rc"; fi
+exit "$input_rc"

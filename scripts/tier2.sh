@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Tier-2 verify: the heaviest closed-loop trainings (maml /
+# meta_policies / vrgripper / transformer-BC / online-qtopt /
+# grasp2vec / pose_env / pipelined-BC end-to-end) and the heaviest
+# equivalence/e2e pins (SavedModel export chain, ring-flash vs
+# reference, 2-worker plane throughput, coldstart smoke), marked
+# @pytest.mark.slow and
+# EXCLUDED from tier-1 so tier-1 fits its 870 s budget on degraded
+# hosts (ROADMAP open item). Same log/DOTS_PASSED shape as tier-1 but
+# its own lane and its own timeout — these are learning-quality tests
+# (loss-must-drop / success-rate bars), minutes each on a loaded
+# 2-core host.
+#
+# Usage: scripts/tier2.sh   (from the repo root)
+set -u
+cd "$(dirname "$0")/.."
+
+set -o pipefail
+rm -f /tmp/_t2.log
+timeout -k 10 1800 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+  -m slow --continue-on-collection-errors -p no:cacheprovider \
+  -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t2.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t2.log | tr -cd . | wc -c)
+exit $rc

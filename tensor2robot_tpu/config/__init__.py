@@ -20,4 +20,5 @@ from tensor2robot_tpu.config.ginlite import (
     parse_config_files_and_bindings,
     parse_value,
     query_parameter,
+    register_lazy_configurables,
 )

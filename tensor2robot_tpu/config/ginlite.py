@@ -64,6 +64,9 @@ class _Registry:
     self.lock = threading.RLock()
     # names actually used at call time, for operative_config_str.
     self.operative: Dict[Tuple[str, str], Dict[str, Any]] = {}
+    # configurable name -> module whose import registers it (see
+    # register_lazy_configurables).
+    self.lazy_modules: Dict[str, str] = {}
 
 
 _REGISTRY = _Registry()
@@ -314,6 +317,23 @@ def _infer_module(fn) -> str:
   return mod.rsplit(".", 1)[-1] if mod else ""
 
 
+def register_lazy_configurables(module_path: str,
+                                names: Sequence[str]) -> None:
+  """Declares that importing `module_path` registers `names`.
+
+  For packages whose __init__ resolves exports lazily (PEP 562 — e.g.
+  `tensor2robot_tpu.data`, whose `prefetch` submodule drags jax into
+  data-plane worker processes that only parse and memcpy): importing
+  the package no longer runs the `@configurable` decorators, so the
+  first *config reference* to one of `names` imports `module_path`
+  instead. Registration stays exactly as eager as config parsing needs
+  while the import stays as lazy as the worker spawn path wants.
+  """
+  with _REGISTRY.lock:
+    for name in names:
+      _REGISTRY.lazy_modules[name] = module_path
+
+
 def _lookup_configurable(name: str) -> Optional[_Configurable]:
   with _REGISTRY.lock:
     if name in _REGISTRY.configurables:
@@ -332,7 +352,19 @@ def _lookup_configurable(name: str) -> Optional[_Configurable]:
       raise GinError(
           f"Ambiguous configurable name {name!r}; candidates: "
           f"{sorted(c.full_name for c in matches.values())}")
-  return None
+    lazy_module = (_REGISTRY.lazy_modules.get(name) or
+                   _REGISTRY.lazy_modules.get(name.rsplit(".", 1)[-1]))
+  if lazy_module is None:
+    return None
+  # Import OUTSIDE the registry lock: the module's @configurable
+  # decorators re-enter it, and holding it across the interpreter's
+  # import lock could deadlock against another importing thread.
+  importlib.import_module(lazy_module)
+  with _REGISTRY.lock:
+    _REGISTRY.lazy_modules = {
+        n: m for n, m in _REGISTRY.lazy_modules.items()
+        if m != lazy_module}
+  return _lookup_configurable(name)
 
 
 # ---------------------------------------------------------------------------

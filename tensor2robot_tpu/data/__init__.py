@@ -1,23 +1,66 @@
-"""Spec-driven input pipelines (reference: tensor2robot input_generators/)."""
+"""Spec-driven input pipelines (reference: tensor2robot input_generators/).
 
-from tensor2robot_tpu.data.abstract_input_generator import (
-    AbstractInputGenerator,
-    Mode,
-)
-from tensor2robot_tpu.data.random_input_generator import (
-    DefaultRandomInputGenerator,
-    RandomInputGenerator,
-)
-from tensor2robot_tpu.data.tfrecord_input_generator import (
-    DefaultRecordInputGenerator,
-    TFRecordEpisodeInputGenerator,
-    TFRecordInputGenerator,
-    write_episode_tfrecord,
-    write_tfrecord,
-)
-from tensor2robot_tpu.data.prefetch import (
-    ShardedPrefetcher,
-    device_put_batch,
-    make_data_sharding,
-    prefetch_to_mesh,
-)
+Exports resolve LAZILY (PEP 562): data-plane worker processes import
+`tensor2robot_tpu.data.plane` at spawn, and an eager package init would
+drag `prefetch`'s jax import (seconds of spin-up per worker) into
+processes that only parse and memcpy. Consumers see the same names;
+only the import moment moves.
+
+Gin registration must NOT move with it: `run_t2r_trainer` parses
+shipped configs right after `importlib.import_module
+("tensor2robot_tpu.data")`, before any attribute access, so the
+`@gin.configurable` names are declared below via
+`register_lazy_configurables` — the first config reference imports the
+defining submodule (registering it) instead of failing unregistered.
+"""
+
+from tensor2robot_tpu import config as _gin
+
+_EXPORTS = {
+    "AbstractInputGenerator": "abstract_input_generator",
+    "Mode": "abstract_input_generator",
+    "DefaultRandomInputGenerator": "random_input_generator",
+    "RandomInputGenerator": "random_input_generator",
+    "DefaultRecordInputGenerator": "tfrecord_input_generator",
+    "TFRecordEpisodeInputGenerator": "tfrecord_input_generator",
+    "TFRecordInputGenerator": "tfrecord_input_generator",
+    "write_episode_tfrecord": "tfrecord_input_generator",
+    "write_tfrecord": "tfrecord_input_generator",
+    "ShardedPrefetcher": "prefetch",
+    "TimedIterator": "prefetch",
+    "device_put_batch": "prefetch",
+    "make_data_sharding": "prefetch",
+    "prefetch_to_mesh": "prefetch",
+    "stack_batches": "prefetch",
+    "HostDataPlane": "plane",
+    "ShmRing": "shm_ring",
+    "WireLayout": "shm_ring",
+}
+
+__all__ = sorted(_EXPORTS)
+
+for _name, _mod in (("RandomInputGenerator", "random_input_generator"),
+                    ("TFRecordInputGenerator", "tfrecord_input_generator"),
+                    ("TFRecordEpisodeInputGenerator",
+                     "tfrecord_input_generator"),
+                    ("prefetch_buffer_size", "prefetch"),
+                    ("HostDataPlane", "plane")):
+  _gin.register_lazy_configurables(f"{__name__}.{_mod}", (_name,))
+del _name, _mod
+
+
+def __getattr__(name):
+  module_name = _EXPORTS.get(name)
+  if module_name is None:
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
+  import importlib
+
+  module = importlib.import_module(f"{__name__}.{module_name}")
+  value = getattr(module, name)
+  globals()[name] = value  # cache: next access skips __getattr__
+  return value
+
+
+def __dir__():
+  return __all__

@@ -14,6 +14,7 @@ from __future__ import annotations
 import collections
 import queue
 import threading
+import time
 from typing import Any, Iterator, Optional
 
 import jax
@@ -77,35 +78,62 @@ def validate_steps_per_dispatch(k: int, **cadences: Optional[int]
   return k
 
 
-def stack_batches(stream: Iterator[Any], k: int) -> Iterator[Any]:
+class StackedBatchStream:
   """Groups K consecutive batches into one [K, B, ...]-stacked pytree.
 
   The host side of `steps_per_dispatch`: the trainer's scan consumes
   one stacked block per device program. A finite stream that runs dry
   mid-stack ends the output stream cleanly (the partial stack is
-  dropped — PEP 479 would otherwise turn the inner StopIteration into
-  a RuntimeError and crash the run past its final checkpoint) and the
-  drop is LOGGED: a dataset whose length isn't a multiple of K trains
-  up to K-1 fewer steps than K=1 would, and that must not be silent.
-  """
-  import logging
+  dropped) and the drop is LOGGED: a dataset whose length isn't a
+  multiple of K trains up to K-1 fewer steps than K=1 would, and that
+  must not be silent.
 
-  it = iter(stream)
-  while True:
+  A class rather than a generator so `close()` works CROSS-THREAD: the
+  inner stream may own real resources — a data-plane stream owns worker
+  PROCESSES — and `ShardedPrefetcher.close` must be able to reach them
+  from the consumer thread while the prefetch thread is still blocked
+  inside `__next__` (a generator would refuse with "generator already
+  executing"; closing the plane instead UNBLOCKS that thread).
+  """
+
+  def __init__(self, stream: Iterator[Any], k: int):
+    self._it = iter(stream)
+    self._k = int(k)
+    self._exhausted = False
+
+  def __iter__(self):
+    return self
+
+  def __next__(self):
+    if self._exhausted:
+      raise StopIteration
     batches = []
-    for _ in range(k):
+    for _ in range(self._k):
       try:
-        batches.append(next(it))
+        batches.append(next(self._it))
       except StopIteration:
+        self._exhausted = True
         if batches:
+          import logging
+
           logging.getLogger(__name__).warning(
               "steps_per_dispatch=%d dropped a partial tail of %d "
               "batch(es): the finite input stream's length is not a "
               "multiple of K, so this run trains %d fewer step(s) "
-              "than K=1 would.", k, len(batches), len(batches))
-        return
-    yield jax.tree_util.tree_map(
+              "than K=1 would.", self._k, len(batches), len(batches))
+        self.close()  # the inner stream is done: release it now
+        raise
+    return jax.tree_util.tree_map(
         lambda *xs: np.stack(xs), *batches)
+
+  def close(self) -> None:
+    closer = getattr(self._it, "close", None)
+    if callable(closer):
+      closer()
+
+
+def stack_batches(stream: Iterator[Any], k: int) -> StackedBatchStream:
+  return StackedBatchStream(stream, k)
 
 
 def scan_k_steps(step_fn, state, stacked_batches, rng, step0):
@@ -187,9 +215,19 @@ class ShardedPrefetcher:
     self._thread.start()
 
   def _worker(self):
+    # Zero-copy source protocol (data-plane streams): batches are
+    # views into a shared-memory ring; the slot may only recycle once
+    # the device owns the bytes, so block on the transfer, then
+    # release. Sources without the protocol are unaffected.
+    release = None
+    if getattr(self._iterator, "release_after_transfer", False):
+      release = getattr(self._iterator, "release_consumed", None)
     try:
       for batch in self._iterator:
         placed = device_put_batch(batch, self._sharding)
+        if release is not None:
+          jax.block_until_ready(placed)
+          release()
         # Bounded put that notices close(): don't block forever holding
         # device buffers once the consumer abandoned the stream.
         while not self._stop.is_set():
@@ -212,12 +250,38 @@ class ShardedPrefetcher:
         except queue.Full:
           continue
 
-  def close(self) -> None:
+  def _close_source(self) -> bool:
+    """Closes the input stream; True unless it must be retried.
+
+    A plain generator refuses a cross-thread close while the prefetch
+    thread is executing it (ValueError: generator already executing) —
+    that is the one retryable outcome. Data-plane chains
+    (`HostDataPlane` / `_PlaneStream` / `StackedBatchStream`) close
+    from any thread.
+    """
+    closer = getattr(self._iterator, "close", None)
+    if not callable(closer):
+      return True
+    try:
+      closer()
+      return True
+    except ValueError:  # generator running in the prefetch thread
+      return False
+    except Exception:  # pragma: no cover - teardown must not raise
+      import logging
+      logging.getLogger(__name__).warning(
+          "input stream close() failed", exc_info=True)
+      return True
+
+  def close(self, timeout_secs: float = 5.0) -> None:
     """Stops the worker and releases buffered device batches.
 
     Call when abandoning the stream early (e.g. bounded eval over an
     infinite generator); otherwise the worker thread would sit blocked
-    holding `buffer_size` device-resident batches.
+    holding `buffer_size` device-resident batches. Closes the source
+    too: data-plane streams own worker PROCESSES and a shared-memory
+    segment — abandoning the prefetcher must not leak them (pinned by
+    tests/test_data_plane.py).
     """
     self._stop.set()
     while True:
@@ -225,7 +289,26 @@ class ShardedPrefetcher:
         self._queue.get_nowait()
       except queue.Empty:
         break
-    self._thread.join(timeout=5.0)
+    self._thread.join(timeout=timeout_secs)
+    if self._thread.is_alive():
+      # The thread is stuck inside next(source) — e.g. a starved
+      # HostDataPlane polling its full queue, which no stop flag of
+      # OURS interrupts. Closing the source from here UNBLOCKS it
+      # (plane close terminates workers; the blocked __next__ raises),
+      # so the join below reclaims the thread instead of leaking the
+      # whole chain behind a 5s shrug.
+      closed = self._close_source()
+      self._thread.join(timeout=timeout_secs)
+      if not closed and not self._thread.is_alive():
+        closed = self._close_source()  # generator now suspended: retry
+      if not closed:
+        import logging
+        logging.getLogger(__name__).warning(
+            "input stream close() could not run: the prefetch thread "
+            "is still executing the source generator; its resources "
+            "may leak until process exit")
+    else:
+      self._close_source()
 
   def __iter__(self):
     return self
@@ -239,6 +322,40 @@ class ShardedPrefetcher:
         raise self._error
       raise StopIteration
     return item
+
+
+class TimedIterator:
+  """Iterator wrapper accumulating wall time spent blocked in `next()`.
+
+  The `input_wait_fraction` measurement both trainers log: near 0 the
+  feed keeps up (the device is the bottleneck); toward 1 the chip
+  starves — the continuously-measured form of the bench's `feeds_chip`
+  verdict. Shared here so the two train loops' feed-boundness metric
+  cannot drift apart. Raise `TFRecordInputGenerator.num_workers` (the
+  process-parallel data plane, docs/DATA.md) when it climbs.
+  """
+
+  def __init__(self, iterator: Iterator[Any]):
+    self._it = iter(iterator)
+    self.wait_secs = 0.0
+
+  def __iter__(self):
+    return self
+
+  def __next__(self):
+    t0 = time.perf_counter()
+    try:
+      return next(self._it)
+    finally:
+      self.wait_secs += time.perf_counter() - t0
+
+  def wait_fraction(self, interval_secs: float) -> float:
+    """Clamped share of `interval_secs` spent blocked; resets the
+    accumulator (one call per log interval)."""
+    fraction = min(max(self.wait_secs / max(interval_secs, 1e-9), 0.0),
+                   1.0)
+    self.wait_secs = 0.0
+    return fraction
 
 
 def prefetch_to_mesh(iterator: Iterator[Any],

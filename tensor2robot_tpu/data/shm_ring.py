@@ -1,0 +1,201 @@
+"""Shared-memory batch ring: the zero-copy seam of the host data plane.
+
+A ring of fixed-size SLOTS in one `multiprocessing.shared_memory`
+segment. Each slot holds exactly one finished batch laid out by a
+`WireLayout` — every key at a fixed 64-byte-aligned offset — so a
+producer process fills a slot with plain memcpys (`write`) and the
+consumer maps the same bytes as numpy arrays WITHOUT copying (`views`).
+That is the whole point of the design: the expensive work (proto parse,
+jpeg decode) happens in worker processes that sidestep the GIL, and the
+bytes they produce cross the process boundary zero-copy — the only
+per-batch cost on the consumer is pointer arithmetic.
+
+Slot accounting (which slots are free, which hold finished batches)
+deliberately lives OUTSIDE this module: `data.plane` runs free/full
+queues around the ring, which keeps this file a dumb, easily-audited
+memory map. Nothing here synchronizes; callers must never write a slot
+the consumer still views (the plane's queue discipline guarantees it).
+
+Consumer-view lifetime contract: arrays returned by `views(slot)` alias
+the shared segment. They are valid only until the slot is handed back
+to a producer; anyone retaining a batch past that point must copy. The
+plane's stream wrappers make that contract concrete (and default to
+copying where a downstream zero-copy alias would be unsafe — see
+`data.plane.h2d_aliases_host_memory`).
+"""
+
+from __future__ import annotations
+
+from multiprocessing import shared_memory
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_ALIGN = 64  # cache-line alignment for every array start
+
+
+def _np_dtype(dtype) -> np.dtype:
+  """np.dtype for a spec/layout dtype, tolerating bfloat16.
+
+  `np.dtype("bfloat16")` only resolves once ml_dtypes registered it
+  (importing jax or tensorflow does); resolve through ml_dtypes
+  directly so layouts built in a TF-only worker and a JAX-only
+  consumer agree bit-for-bit.
+  """
+  name = getattr(dtype, "name", None) or str(dtype)
+  if name == "bfloat16":
+    import ml_dtypes
+    return np.dtype(ml_dtypes.bfloat16)
+  return np.dtype(dtype)
+
+
+class WireLayout:
+  """Fixed (key, shape, dtype) fields → slot byte layout.
+
+  Shapes are FULL batch shapes ([B, ...]); the layout is the contract
+  both sides compute independently from the same spec structure, so
+  field order must be deterministic — callers pass fields sorted (or
+  otherwise canonically ordered) and `assert_matches` exists for
+  debugging drift.
+  """
+
+  def __init__(self, fields: Sequence[Tuple[str, Tuple[int, ...], str]]):
+    if not fields:
+      raise ValueError("WireLayout needs at least one field")
+    self.fields: List[Tuple[str, Tuple[int, ...], str]] = [
+        (str(k), tuple(int(d) for d in shape), str(dtype))
+        for k, shape, dtype in fields]
+    self.offsets: Dict[str, int] = {}
+    cursor = 0
+    for key, shape, dtype in self.fields:
+      if key in self.offsets:
+        raise ValueError(f"Duplicate layout key {key!r}")
+      cursor = -(-cursor // _ALIGN) * _ALIGN  # round up
+      self.offsets[key] = cursor
+      cursor += int(np.prod(shape, dtype=np.int64)) * _np_dtype(
+          dtype).itemsize
+    self.slot_bytes = max(-(-cursor // _ALIGN) * _ALIGN, _ALIGN)
+
+  @classmethod
+  def from_flat_specs(cls, flat_specs: Dict[str, object],
+                      batch_size: int,
+                      leading_dims: Dict[str, Tuple[int, ...]] = None,
+                      extra_fields: Iterable[
+                          Tuple[str, Tuple[int, ...], str]] = ()):
+    """Layout for [B, ...]-batched parse output of a flat spec dict.
+
+    `leading_dims` inserts per-key dims between the batch dim and the
+    spec shape (the episode generator's [B, T, ...] sequence keys);
+    `extra_fields` appends parser-emitted keys that have no spec (the
+    episode generator's true-lengths vector).
+    """
+    leading_dims = leading_dims or {}
+    fields = []
+    for key in sorted(flat_specs):
+      spec = flat_specs[key]
+      shape = ((batch_size,) + tuple(leading_dims.get(key, ()))
+               + tuple(int(d) for d in spec.shape))
+      fields.append((key, shape, _np_dtype(spec.dtype).name))
+    fields.extend(extra_fields)
+    return cls(fields)
+
+  def check_batch(self, flat: Dict[str, np.ndarray]) -> None:
+    """Raises if a producer batch doesn't conform (shape/dtype/keys)."""
+    keys = {k for k, _, _ in self.fields}
+    if set(flat) != keys:
+      raise ValueError(
+          f"Batch keys {sorted(flat)} != layout keys {sorted(keys)}")
+    for key, shape, dtype in self.fields:
+      arr = np.asarray(flat[key])
+      if tuple(arr.shape) != shape or arr.dtype != _np_dtype(dtype):
+        raise ValueError(
+            f"Field {key!r}: got {arr.dtype} {tuple(arr.shape)}, "
+            f"layout says {dtype} {shape}")
+
+
+class ShmRing:
+  """`num_slots` fixed-layout batch slots in one shared segment."""
+
+  def __init__(self, layout: WireLayout, num_slots: int,
+               name: Optional[str] = None, create: bool = True):
+    if num_slots < 1:
+      raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+    self.layout = layout
+    self.num_slots = int(num_slots)
+    if create:
+      self._shm = shared_memory.SharedMemory(
+          create=True, size=layout.slot_bytes * self.num_slots)
+    else:
+      self._shm = shared_memory.SharedMemory(name=name)
+    self._owner = create
+    self._closed = False
+
+  @property
+  def name(self) -> str:
+    return self._shm.name
+
+  @classmethod
+  def attach(cls, name: str, layout: WireLayout,
+             num_slots: int) -> "ShmRing":
+    """Maps an existing ring (worker side).
+
+    Keeping the attach OUT of the stdlib resource tracker matters:
+    workers share the creator's tracker process, and a worker's
+    register/unregister of the same name races the creator's unlink
+    into noisy KeyErrors (and, pre-3.13, into the tracker "cleaning
+    up" — unlinking! — a segment its siblings still use). Ownership is
+    the creator's alone, so the attach suppresses registration instead
+    of unregistering after the fact.
+    """
+    from multiprocessing import resource_tracker
+    orig_register = resource_tracker.register
+
+    def _no_shm_register(rname, rtype):
+      if rtype != "shared_memory":
+        orig_register(rname, rtype)
+
+    resource_tracker.register = _no_shm_register
+    try:
+      return cls(layout, num_slots, name=name, create=False)
+    finally:
+      resource_tracker.register = orig_register
+
+  def _view(self, slot: int, key: str, shape, dtype) -> np.ndarray:
+    base = slot * self.layout.slot_bytes + self.layout.offsets[key]
+    return np.ndarray(shape, dtype=_np_dtype(dtype),
+                      buffer=self._shm.buf, offset=base)
+
+  def write(self, slot: int, flat: Dict[str, np.ndarray]) -> None:
+    """Producer: memcpy one conforming batch into `slot`."""
+    self.layout.check_batch(flat)
+    for key, shape, dtype in self.layout.fields:
+      np.copyto(self._view(slot, key, shape, dtype),
+                np.asarray(flat[key]))
+
+  def views(self, slot: int) -> Dict[str, np.ndarray]:
+    """Consumer: zero-copy numpy views of one slot (see module
+    docstring for the lifetime contract)."""
+    if not 0 <= slot < self.num_slots:
+      raise IndexError(f"slot {slot} out of range 0..{self.num_slots-1}")
+    return {key: self._view(slot, key, shape, dtype)
+            for key, shape, dtype in self.layout.fields}
+
+  def close(self) -> None:
+    """Unmaps; the creating side also unlinks the segment."""
+    if self._closed:
+      return
+    self._closed = True
+    try:
+      self._shm.close()
+    except BufferError:
+      # Live numpy views pin the mmap; the consumer tears the plane
+      # down while batches may still be referenced (e.g. an exception
+      # unwinding mid-step). Leave the map to process exit — unlink
+      # below still removes the *name*, so nothing leaks past the
+      # process.
+      pass
+    if self._owner:
+      try:
+        self._shm.unlink()
+      except FileNotFoundError:  # pragma: no cover - double close race
+        pass

@@ -12,8 +12,10 @@ steps need static batch shapes.
 
 from __future__ import annotations
 
+import copy as copylib
 import glob as globlib
-from typing import Iterator, List, Optional, Sequence, Tuple, Union
+import os
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
@@ -24,6 +26,7 @@ from tensor2robot_tpu.data.abstract_input_generator import (
     AbstractInputGenerator,
     Mode,
 )
+from tensor2robot_tpu.data.shm_ring import WireLayout
 from tensor2robot_tpu.specs import TensorSpecStruct
 
 
@@ -37,9 +40,94 @@ def _merge_specs(feature_spec, label_spec=None) -> TensorSpecStruct:
   return TensorSpecStruct.from_flat_dict(merged)
 
 
+class _WorkerSource:
+  """Picklable worker body: one file shard → parsed flat-dict batches.
+
+  Instances cross the spawn boundary into `HostDataPlane` workers, so
+  they carry the GENERATOR itself (plain fields + picklable specs)
+  with `num_workers` forced to 0 — a worker must never recurse into
+  another plane.
+  """
+
+  def __init__(self, generator: "TFRecordInputGenerator", mode: Mode,
+               batch_size: int):
+    worker_gen = copylib.copy(generator)
+    worker_gen._num_workers = 0
+    self._generator = worker_gen
+    self._mode = Mode(mode)
+    self._batch_size = int(batch_size)
+
+  def __call__(self, worker_index: int, num_workers: int
+               ) -> Iterator[Dict[str, np.ndarray]]:
+    # Keep the worker's TF quiet and host-side (mirrors what the test
+    # conftest / trainer environment set for the parent).
+    os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+    os.environ.setdefault("CUDA_VISIBLE_DEVICES", "-1")
+    gen = self._generator
+    # The DETERMINISTIC shard: worker i of N owns files[i::N] of the
+    # sorted file list. N=1 degenerates to the full list in the same
+    # order — the num_workers ∈ {0, 1} bitwise-identity contract.
+    gen._files_override = gen._file_list()[worker_index::num_workers]
+    if not gen._files_override:
+      return  # more workers than files: this worker has no shard
+    merged_struct, _, _ = gen._merged_spec()
+    parse_fn = gen._parse_fn(merged_struct)
+    for flat in gen._batched_dataset(self._mode, self._batch_size,
+                                     parse_fn):
+      yield dict(flat)
+
+
+class _PlaneStream:
+  """Plane batches → (features, labels) structs, release/close plumbed.
+
+  The attributes `release_after_transfer` / `release_consumed` are the
+  `ShardedPrefetcher` zero-copy protocol: when batches are ring VIEWS
+  (plane copy mode off), the prefetcher blocks until the H2D transfer
+  completes and then calls `release_consumed()` so the slot recycles
+  only once the device owns the bytes.
+  """
+
+  def __init__(self, plane, split_fn):
+    self._plane = plane
+    self._split = split_fn
+
+  @property
+  def release_after_transfer(self) -> bool:
+    return not self._plane.copies_batches
+
+  def release_consumed(self) -> None:
+    self._plane.release()
+
+  def require_copies(self) -> None:
+    """Callers that retain batches past the next __next__ (K-step
+    stacking) force copy-out mode."""
+    self._plane.require_copies()
+
+  def __iter__(self):
+    return self
+
+  def __next__(self):
+    return self._split(
+        TensorSpecStruct.from_flat_dict(dict(next(self._plane))))
+
+  def close(self) -> None:
+    self._plane.close()
+
+
 @gin.configurable
 class TFRecordInputGenerator(AbstractInputGenerator):
-  """Streams parsed batches from TFRecord shards."""
+  """Streams parsed batches from TFRecord shards.
+
+  `num_workers=0` (default) parses in-process under tf.data AUTOTUNE —
+  the reference shape, capped near one core of decode. `num_workers>0`
+  fans the SAME pipeline out over that many worker processes through
+  `data.plane.HostDataPlane` (each worker owns files[i::N] of the
+  sorted file list; finished batches cross a shared-memory ring
+  zero-copy). `num_workers=1` is pinned bitwise-identical to the
+  in-process stream under a fixed seed; `num_workers>1` interleaves
+  worker suborders by completion and is for throughput, not
+  repeatability. See docs/DATA.md.
+  """
 
   def __init__(self,
                file_patterns: Union[str, Sequence[str]] = "",
@@ -48,7 +136,10 @@ class TFRecordInputGenerator(AbstractInputGenerator):
                num_parallel_reads: int = 4,
                shuffle: bool = True,
                repeat: bool = True,
-               seed: Optional[int] = None):
+               seed: Optional[int] = None,
+               num_workers: int = 0,
+               plane_slots_per_worker: int = 2,
+               plane_copy: Optional[bool] = None):
     super().__init__(batch_size=batch_size)
     if isinstance(file_patterns, str):
       file_patterns = [p for p in file_patterns.split(",") if p]
@@ -58,8 +149,16 @@ class TFRecordInputGenerator(AbstractInputGenerator):
     self._shuffle = shuffle
     self._repeat = repeat
     self._seed = seed
+    if num_workers < 0:
+      raise ValueError(f"num_workers must be >= 0, got {num_workers}")
+    self._num_workers = int(num_workers)
+    self._plane_slots_per_worker = int(plane_slots_per_worker)
+    self._plane_copy = plane_copy
+    self._files_override: Optional[List[str]] = None
 
   def _file_list(self) -> List[str]:
+    if self._files_override is not None:
+      return list(self._files_override)
     files: List[str] = []
     for pattern in self._file_patterns:
       matched = sorted(globlib.glob(pattern))
@@ -131,16 +230,57 @@ class TFRecordInputGenerator(AbstractInputGenerator):
           {k: v for k, v in flat.items() if k in label_keys})
     return features, labels
 
+  # ---- parse/layout hooks (the episode subclass overrides all three,
+  # so the plane path below serves both wire formats unchanged) ----
+
+  def _parse_fn(self, merged_struct):
+    return lambda serialized: tfexample.graph_parse_example(
+        serialized, merged_struct)
+
+  def _extra_feature_keys(self) -> Tuple[str, ...]:
+    """Parser-emitted keys forwarded into features beyond the spec."""
+    return ()
+
+  def _plane_layout(self, merged_struct, batch_size: int) -> WireLayout:
+    """The shm-ring slot layout of one parsed batch."""
+    return WireLayout.from_flat_specs(
+        merged_struct.to_flat_dict(), batch_size)
+
+  def _plane_stream(self, mode: Mode, batch_size: int) -> _PlaneStream:
+    from tensor2robot_tpu.data.plane import HostDataPlane  # lazy
+
+    merged_struct, feature_keys, label_keys = self._merged_spec()
+    extra = self._extra_feature_keys()
+    plane = HostDataPlane(
+        _WorkerSource(self, mode, batch_size),
+        self._plane_layout(merged_struct, batch_size),
+        num_workers=self._num_workers,
+        slots_per_worker=self._plane_slots_per_worker,
+        copy=self._plane_copy)
+
+    def split(parsed):
+      return self._split_parsed(parsed, feature_keys, label_keys,
+                                extra_feature_keys=extra)
+
+    return _PlaneStream(plane, split)
+
   def _create_dataset(
       self, mode: Mode, batch_size: int,
   ) -> Iterator[Tuple[TensorSpecStruct, Optional[TensorSpecStruct]]]:
+    if self._num_workers > 0:
+      return self._plane_stream(mode, batch_size)
+    return self._inprocess_stream(mode, batch_size)
+
+  def _inprocess_stream(
+      self, mode: Mode, batch_size: int,
+  ) -> Iterator[Tuple[TensorSpecStruct, Optional[TensorSpecStruct]]]:
     merged_struct, feature_keys, label_keys = self._merged_spec()
-    parse_fn = lambda serialized: tfexample.graph_parse_example(  # noqa: E731
-        serialized, merged_struct)
+    parse_fn = self._parse_fn(merged_struct)
+    extra = self._extra_feature_keys()
     for flat in self._batched_dataset(mode, batch_size, parse_fn):
       yield self._split_parsed(
           TensorSpecStruct.from_flat_dict(dict(flat)),
-          feature_keys, label_keys)
+          feature_keys, label_keys, extra_feature_keys=extra)
 
 
 # Reference-compatible alias.
@@ -170,20 +310,28 @@ class TFRecordEpisodeInputGenerator(TFRecordInputGenerator):
   def sequence_length(self) -> int:
     return self._sequence_length
 
-  def _create_dataset(
-      self, mode: Mode, batch_size: int,
-  ) -> Iterator[Tuple[TensorSpecStruct, Optional[TensorSpecStruct]]]:
-    merged_struct, feature_keys, label_keys = self._merged_spec()
+  def _parse_fn(self, merged_struct):
+    return lambda s: tfexample.graph_parse_sequence_example(
+        s, merged_struct, self._sequence_length)
+
+  def _extra_feature_keys(self) -> Tuple[str, ...]:
     # _split_parsed only forwards keys it is told about, so excluding
     # the lengths is just not listing them.
-    extra = ((tfexample.SEQUENCE_LENGTH_KEY,)
-             if self._include_sequence_length else ())
-    parse_fn = lambda s: tfexample.graph_parse_sequence_example(  # noqa: E731
-        s, merged_struct, self._sequence_length)
-    for flat in self._batched_dataset(mode, batch_size, parse_fn):
-      yield self._split_parsed(
-          TensorSpecStruct.from_flat_dict(dict(flat)),
-          feature_keys, label_keys, extra_feature_keys=extra)
+    return ((tfexample.SEQUENCE_LENGTH_KEY,)
+            if self._include_sequence_length else ())
+
+  def _plane_layout(self, merged_struct, batch_size: int) -> WireLayout:
+    # Sequence keys come back [B, T, ...]; the parser additionally
+    # always emits the true pre-pad lengths (spec-less, so appended as
+    # an extra layout field — the ring carries the parser's FULL
+    # output and the consumer-side split decides what to forward).
+    flat = merged_struct.to_flat_dict()
+    leading = {k: (self._sequence_length,)
+               for k, s in flat.items() if s.is_sequence}
+    return WireLayout.from_flat_specs(
+        flat, batch_size, leading_dims=leading,
+        extra_fields=((tfexample.SEQUENCE_LENGTH_KEY,
+                       (batch_size,), "int32"),))
 
 
 def write_tfrecord(

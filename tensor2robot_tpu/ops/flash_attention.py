@@ -179,19 +179,17 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
   def _finalize():
     l_final = jnp.maximum(l_scr[...], 1e-30)
     o_ref[0] = (acc_scr[...] / l_final).astype(o_ref.dtype)
-    # The per-row lse lives on the SUBLANE dim ([block_q, 1], the
-    # reduction layout) but is stored densest across LANES — a
-    # broadcast to 128 lanes (the round-3 layout) multiplied lse HBM
-    # traffic 128×: ~134 MB of spurious writes per layer at T=32k.
-    # Mosaic cannot relayout sublanes→lanes with a reshape, so
-    # transpose on the MXU (v^T = v·I, contracting dim 0 against an
-    # identity), then pad to the minimum (8, 128) f32 output tile —
-    # 8 sublanes of redundancy instead of 128 lanes: 16× less traffic.
-    lse_val = m_scr[...] + jnp.log(l_final)       # [block_q, 1]
-    lse_row = jax.lax.dot_general(
-        lse_val, jnp.eye(block_q, dtype=jnp.float32),
-        (((0,), (0,)), ((), ())))                 # [1, block_q]
-    lse_ref[0, 0] = jnp.broadcast_to(lse_row, (8, block_q))
+    # The per-row lse stays SUBLANE-major ([block_q, 1]) end to end:
+    # that is the reduction layout m/l already live in, it is the
+    # layout the backward broadcasts against score tiles, and storing
+    # it directly is a plain VMEM→HBM copy of T×4 bytes per head.
+    # Round 3 broadcast to 128 lanes (~134 MB of spurious writes per
+    # layer at T=32k); rounds 4-5 transposed to lanes via an MXU
+    # identity matmul (8× traffic + one systolic-array pass of
+    # f32-emulation error on every lse, which the backward then paid
+    # AGAIN relayouting back — the round-5 advisor's dv-error
+    # finding). No matmul touches the lse anymore.
+    lse_ref[0, 0] = m_scr[...] + jnp.log(l_final)  # [block_q, 1]
 
 
 def _flash_forward_impl(q, k, v, causal: bool, block_q: int,
@@ -220,14 +218,15 @@ def _flash_forward_impl(q, k, v, causal: bool, block_q: int,
       ],
       out_specs=[
           pl.BlockSpec((1, block_q, d), lambda g, i, j: (g, i, 0)),
-          # lse packed [BH, num_q_blocks, 8, block_q]: per q-block one
-          # minimum (8, block_q) f32 tile whose sublanes repeat the
-          # lane row (t×8 values total, not the t×128 broadcast).
-          pl.BlockSpec((1, 1, 8, block_q), lambda g, i, j: (g, i, 0, 0)),
+          # lse packed [BH, num_q_blocks, block_q, 1]: sublane-major
+          # per-row values, the same (block_q, 1) class as the m/l
+          # scratch — T×4 bytes per head, no lane broadcast, no MXU
+          # relayout (see _finalize).
+          pl.BlockSpec((1, 1, block_q, 1), lambda g, i, j: (g, i, 0, 0)),
       ],
       out_shape=[
           jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
-          jax.ShapeDtypeStruct((b * h, num_q_blocks, 8, block_q),
+          jax.ShapeDtypeStruct((b * h, num_q_blocks, block_q, 1),
                                jnp.float32),
       ],
       scratch_shapes=[
@@ -238,25 +237,7 @@ def _flash_forward_impl(q, k, v, causal: bool, block_q: int,
       interpret=interpret,
   )(fold(q), fold(k), fold(v))
   return (out.reshape(b, h, t, d).transpose(0, 2, 1, 3),
-          lse[:, :, 0, :].reshape(b * h, t))
-
-
-def _rows_to_col(x):
-  """(8, n) tile with identical rows → (n, 1) on the MXU.
-
-  The per-row lse/delta ride into the backward kernels in the SAME
-  (8, block_q) redundant-sublane tile layout the forward stores its
-  lse in (Mosaic block shapes need a sublane dim ≥ 8, and cannot
-  reshape across the sublane/lane boundary) — so the row values sit
-  on LANES but must broadcast against score tiles row-wise, which
-  needs the sublane-major (block_q, 1) layout. Contract the 8
-  redundant sublanes against a constant 1/8 column: one (n×8)·(8×1)
-  matmul, noise next to the (bq×D)·(D×bk) score matmul.
-  """
-  return jax.lax.dot_general(
-      x.astype(jnp.float32),
-      jnp.full((8, 1), 0.125, jnp.float32),
-      (((0,), (0,)), ((), ())))
+          lse.reshape(b * h, t))
 
 
 def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -280,8 +261,10 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     k = k_ref[0]                                   # [bk, D]
     v = v_ref[0]
     do = do_ref[0]                                 # [bq, D]
-    lse = _rows_to_col(lse_ref[0, 0])              # [bq, 1]
-    delta = _rows_to_col(delta_ref[0, 0])          # [bq, 1]
+    # lse/delta arrive sublane-major [bq, 1] — already the layout the
+    # row-wise broadcasts against score tiles need; no relayout.
+    lse = lse_ref[0, 0]
+    delta = delta_ref[0, 0]
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale  # [bq, bk]
@@ -343,8 +326,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     k = k_ref[0]
     v = v_ref[0]
     do = do_ref[0]
-    lse = _rows_to_col(lse_ref[0, 0])
-    delta = _rows_to_col(delta_ref[0, 0])
+    lse = lse_ref[0, 0]       # sublane-major [bq, 1], see _dkdv_kernel
+    delta = delta_ref[0, 0]
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale
@@ -402,20 +385,21 @@ def _flash_bwd_impl(q, k, v, out, lse, do, dlse, causal: bool,
   q_f, k_f, v_f, do_f, o_f = map(fold, (q, k, v, do, out))
   # δ_i = rowsum(dO·O) − dlse_i: the softmax-jacobian row term, a
   # cheap elementwise reduce XLA fuses. Both per-row vectors enter
-  # the kernels in the forward's (8, block_q) redundant-sublane tile
-  # layout (Mosaic block sublane dims must be ≥ 8; the 8× redundancy
-  # is ~T×32 bytes per head — noise next to the q/k/v streams).
+  # the kernels in the forward's SUBLANE-major [BH, nq, block_q, 1]
+  # layout — the broadcast layout the score-tile math needs, so
+  # neither side pays an MXU relayout (rounds 4-5 made two lossy
+  # systolic-array passes here — forward identity-transpose, backward
+  # 1/8-contraction — which was the dominant term in the hardware
+  # gate's dv error; see bench_verify_numerics).
   delta = (jnp.sum(do_f.astype(jnp.float32) * o_f.astype(jnp.float32),
                    axis=-1)
            - dlse.astype(jnp.float32))              # [BH, T]
 
-  def tile_rows(x):  # [BH, T] → [BH, nq, 8, block_q]
-    return jnp.broadcast_to(
-        x.astype(jnp.float32).reshape(b * h, nq, 1, block_q),
-        (b * h, nq, 8, block_q))
+  def tile_cols(x):  # [BH, T] → [BH, nq, block_q, 1]
+    return x.astype(jnp.float32).reshape(b * h, nq, block_q, 1)
 
-  lse = tile_rows(lse)
-  delta = tile_rows(delta)
+  lse = tile_cols(lse)
+  delta = tile_cols(delta)
 
   dk_f, dv_f = pl.pallas_call(
       functools.partial(_dkdv_kernel, scale=scale, causal=causal,
@@ -427,9 +411,9 @@ def _flash_bwd_impl(q, k, v, out, lse, do, dlse, causal: bool,
           pl.BlockSpec((1, block_k, d), lambda g, j, i: (g, j, 0)),
           pl.BlockSpec((1, block_k, d), lambda g, j, i: (g, j, 0)),
           pl.BlockSpec((1, block_q, d), lambda g, j, i: (g, i, 0)),
-          pl.BlockSpec((1, 1, 8, block_q),
+          pl.BlockSpec((1, 1, block_q, 1),
                        lambda g, j, i: (g, i, 0, 0)),
-          pl.BlockSpec((1, 1, 8, block_q),
+          pl.BlockSpec((1, 1, block_q, 1),
                        lambda g, j, i: (g, i, 0, 0)),
       ],
       out_specs=[
@@ -457,9 +441,9 @@ def _flash_bwd_impl(q, k, v, out, lse, do, dlse, causal: bool,
           pl.BlockSpec((1, block_k, d), lambda g, i, j: (g, j, 0)),
           pl.BlockSpec((1, block_k, d), lambda g, i, j: (g, j, 0)),
           pl.BlockSpec((1, block_q, d), lambda g, i, j: (g, i, 0)),
-          pl.BlockSpec((1, 1, 8, block_q),
+          pl.BlockSpec((1, 1, block_q, 1),
                        lambda g, i, j: (g, i, 0, 0)),
-          pl.BlockSpec((1, 1, 8, block_q),
+          pl.BlockSpec((1, 1, block_q, 1),
                        lambda g, i, j: (g, i, 0, 0)),
       ],
       out_specs=[

@@ -184,8 +184,13 @@ def train_qtopt(
   t_last = time.time()
   steps_since_log = 0
   last_saved = resume_step
+  # input_wait_fraction: the measured input-boundness of the
+  # replay→device seam (shared TimedIterator — wall blocked in the
+  # prefetcher's __next__ per log interval), logged beside the
+  # staleness metrics.
+  prefetch_iter = prefetch_lib.TimedIterator(prefetcher)
   try:
-    for transitions in prefetcher:
+    for transitions in prefetch_iter:
       if step >= max_train_steps:
         break
       if k == 1:
@@ -205,6 +210,7 @@ def train_qtopt(
         scalars = jax.device_get(metrics)
         dt = time.time() - t_last
         scalars["grad_steps_per_sec"] = steps_since_log / max(dt, 1e-9)
+        scalars["input_wait_fraction"] = prefetch_iter.wait_fraction(dt)
         # Data-plane instrumentation rides the train log: fill,
         # add/sample rates, drops/evictions, staleness — next to the
         # loop's own throughput, the way stall_fraction is.

@@ -315,6 +315,12 @@ def train_eval_model(
     stream = input_generator_train.create_dataset(
         Mode.TRAIN, batch_size=batch_size)
     if k > 1:
+      # K-stacking retains each batch until the stack closes, past a
+      # zero-copy data-plane stream's one-slot view lifetime — such
+      # streams must copy out of the ring first.
+      require_copies = getattr(stream, "require_copies", None)
+      if require_copies is not None:
+        require_copies()
       # Finite streams end cleanly mid-stack (the shared helper
       # swallows the inner StopIteration PEP 479 would otherwise
       # convert to a RuntimeError, preserving the final
@@ -457,7 +463,11 @@ def train_eval_model(
       # regressions this PR's bench axis watches.
       stall_secs = 0.0
       last_saved_step = resume_step
-      for features, labels in prefetcher:
+      # Input-boundness accounting (input_wait_fraction): the shared
+      # TimedIterator measures wall blocked in the prefetcher's
+      # __next__ per log interval.
+      prefetch_iter = prefetch_lib.TimedIterator(prefetcher)
+      for features, labels in prefetch_iter:
         if step >= max_train_steps:
           break
         if k == 1:
@@ -479,6 +489,7 @@ def train_eval_model(
               dt - stall_secs, 1e-9)
           scalars["stall_fraction"] = min(
               max(stall_secs / max(dt, 1e-9), 0.0), 1.0)
+          scalars["input_wait_fraction"] = prefetch_iter.wait_fraction(dt)
           final_metrics = scalars
           t_last = time.time()
           steps_since_log = 0

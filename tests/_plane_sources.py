@@ -1,0 +1,85 @@
+"""Picklable data-plane worker sources for tests/test_data_plane.py.
+
+A separate MINIMAL module (numpy + os only) on purpose: these classes
+cross the spawn boundary by qualified name, and every import this
+module makes is paid at every worker-process spawn. Keeping it tiny —
+together with `tensor2robot_tpu.data`'s lazy package init — keeps a
+pure-numpy plane worker free of the jax/TF imports that would
+otherwise dominate test wall-clock.
+"""
+
+import os
+
+import numpy as np
+
+
+class CountSource:
+  """Yields n batches total, stamped with their global index."""
+
+  def __init__(self, n):
+    self.n = n
+
+  def __call__(self, widx, nworkers):
+    for i in range(widx, self.n, nworkers):
+      yield {"x": np.full((4, 3), i, np.float32),
+             "y": np.full((4,), i, np.int64)}
+
+
+class CrashSource:
+  """One good batch, then an exception mid-stream."""
+
+  def __call__(self, widx, nworkers):
+    yield {"x": np.zeros((4, 3), np.float32),
+           "y": np.zeros((4,), np.int64)}
+    raise ValueError(f"boom from worker {widx}")
+
+
+class HardDeathSource:
+  """One good batch, then the process dies without a word."""
+
+  def __call__(self, widx, nworkers):
+    yield {"x": np.zeros((4, 3), np.float32),
+           "y": np.zeros((4,), np.int64)}
+    os._exit(3)
+
+
+class SilentExitSource:
+  """One good batch, then a CLEAN exit (code 0) with no done marker —
+  the death mode exit-code-only polling cannot see."""
+
+  def __call__(self, widx, nworkers):
+    yield {"x": np.zeros((4, 3), np.float32),
+           "y": np.zeros((4,), np.int64)}
+    os._exit(0)
+
+
+class DieWhileSiblingsProduceSource:
+  """Worker 0 streams forever; every OTHER worker hard-dies after a
+  few batches — the busy-queue crash-detection case (siblings keep the
+  full queue non-empty, so the empty-window poll alone never fires)."""
+
+  def __call__(self, widx, nworkers):
+    i = 0
+    while True:
+      if widx != 0 and i >= 3:
+        os._exit(5)
+      yield {"x": np.full((4, 3), widx, np.float32),
+             "y": np.full((4,), i, np.int64)}
+      i += 1
+
+
+class StallSource:
+  """A few good batches, then the worker stalls (slow decode stand-in):
+  the consumer's next poll blocks until close() tears the plane down."""
+
+  def __init__(self, n=1, stall_secs=60.0):
+    self.n = n
+    self.stall_secs = stall_secs
+
+  def __call__(self, widx, nworkers):
+    import time
+
+    for i in range(self.n):
+      yield {"x": np.full((4, 3), i, np.float32),
+             "y": np.full((4,), i, np.int64)}
+    time.sleep(self.stall_secs)

@@ -42,6 +42,7 @@ from tensor2robot_tpu.data.prefetch import (  # noqa: E402
     make_data_sharding,
 )
 from tensor2robot_tpu.parallel import create_mesh  # noqa: E402
+from tensor2robot_tpu.parallel.mesh import shard_map_compat  # noqa: E402
 from tensor2robot_tpu.research.qtopt import (  # noqa: E402
     GraspingQModel,
     QTOptLearner,
@@ -57,7 +58,7 @@ def main():
 
   # 1. A psum across ALL devices of BOTH processes.
   total = jax.jit(
-      jax.shard_map(
+      shard_map_compat(
           lambda x: jax.lax.psum(x, "data"),
           mesh=mesh, in_specs=P("data"), out_specs=P()),
       out_shardings=NamedSharding(mesh, P()))(
@@ -118,8 +119,8 @@ def main():
     # Global checksum via a cross-process reduction of the restored
     # sharded array (proves it is usable, not just readable).
     checksum = jax.jit(
-        jax.shard_map(lambda x: jax.lax.psum(jnp.sum(x), "data"),
-                      mesh=mesh, in_specs=P("data"), out_specs=P()),
+        shard_map_compat(lambda x: jax.lax.psum(jnp.sum(x), "data"),
+                         mesh=mesh, in_specs=P("data"), out_specs=P()),
         out_shardings=NamedSharding(mesh, P()))(restored)
     got_sum = float(np.asarray(jax.device_get(checksum)))
     assert got_sum == float(global_w.sum()), (got_sum, global_w.sum())

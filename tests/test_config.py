@@ -209,3 +209,39 @@ class TestReviewRegressions:
     assert Plain().x == 1       # original untouched
     assert wrapped().x == 9     # wrapper injects
     assert isinstance(wrapped(), Plain)
+
+  def test_lazy_registration_in_process(self, tmp_path, monkeypatch):
+    import sys
+
+    (tmp_path / "lazy_reg_target_mod.py").write_text(
+        "from tensor2robot_tpu import config as gin\n"
+        "@gin.configurable\n"
+        "def lazy_reg_fn(value=0):\n"
+        "  return value\n")
+    monkeypatch.syspath_prepend(str(tmp_path))
+    gin.register_lazy_configurables("lazy_reg_target_mod",
+                                    ("lazy_reg_fn",))
+    assert "lazy_reg_target_mod" not in sys.modules
+    gin.parse_config("lazy_reg_fn.value = 5")  # triggers the import
+    assert sys.modules["lazy_reg_target_mod"].lazy_reg_fn() == 5
+
+  def test_lazy_package_registers_data_configurables(self):
+    """run_t2r_trainer regression: `tensor2robot_tpu.data` resolves its
+    exports lazily (PEP 562 — worker spawns must not pay the jax
+    import), but a config binding one of its configurables must still
+    parse right after the bare package import. Subprocess: the trainer
+    registration path with clean module state."""
+    import subprocess
+    import sys
+
+    code = (
+        "import importlib, sys\n"
+        "importlib.import_module('tensor2robot_tpu.data')\n"
+        "assert 'jax' not in sys.modules, 'package import dragged jax'\n"
+        "from tensor2robot_tpu import config as gin\n"
+        "gin.parse_config('RandomInputGenerator.batch_size = 4')\n"
+        "assert gin.query_parameter(\n"
+        "    'RandomInputGenerator.batch_size') == 4\n"
+    )
+    subprocess.run([sys.executable, "-c", code], check=True,
+                   timeout=120)

@@ -1,0 +1,351 @@
+"""Process-parallel host data plane: ring layout, worker lifecycle,
+failure paths, and the num_workers∈{0,1} determinism contract.
+
+The worker source classes live in `_plane_sources` (a minimal
+numpy-only module) because they cross the spawn boundary by qualified
+name and every import that module makes is paid per worker spawn.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from _plane_sources import (
+    CountSource,
+    CrashSource,
+    DieWhileSiblingsProduceSource,
+    HardDeathSource,
+    SilentExitSource,
+    StallSource,
+)
+from tensor2robot_tpu.data.abstract_input_generator import Mode
+from tensor2robot_tpu.data.plane import HostDataPlane
+from tensor2robot_tpu.data.prefetch import (
+    ShardedPrefetcher,
+    make_data_sharding,
+    stack_batches,
+)
+from tensor2robot_tpu.data.shm_ring import ShmRing, WireLayout
+from tensor2robot_tpu.data.tfrecord_input_generator import (
+    TFRecordEpisodeInputGenerator,
+    TFRecordInputGenerator,
+    _PlaneStream,
+    write_episode_tfrecord,
+    write_tfrecord,
+)
+from tensor2robot_tpu.specs import ExtendedTensorSpec, TensorSpecStruct
+
+LAYOUT = WireLayout([("x", (4, 3), "float32"), ("y", (4,), "int64")])
+
+
+def _wait_workers_dead(plane, timeout=10.0):
+  deadline = time.monotonic() + timeout
+  while plane.workers_alive() and time.monotonic() < deadline:
+    time.sleep(0.05)
+  return plane.workers_alive()
+
+
+class TestWireLayout:
+
+  def test_offsets_aligned_and_disjoint(self):
+    layout = WireLayout([("a", (3,), "uint8"), ("b", (2, 2), "float32"),
+                         ("c", (1,), "int64")])
+    offsets = [layout.offsets[k] for k, _, _ in layout.fields]
+    assert all(o % 64 == 0 for o in offsets)
+    assert offsets == sorted(offsets)
+    assert layout.slot_bytes % 64 == 0
+
+  def test_duplicate_key_rejected(self):
+    with pytest.raises(ValueError, match="Duplicate"):
+      WireLayout([("a", (1,), "float32"), ("a", (2,), "float32")])
+
+  def test_write_checks_shape_and_dtype(self):
+    ring = ShmRing(LAYOUT, num_slots=1)
+    try:
+      with pytest.raises(ValueError, match="layout says"):
+        ring.write(0, {"x": np.zeros((4, 3), np.float64),
+                       "y": np.zeros((4,), np.int64)})
+      with pytest.raises(ValueError, match="layout says"):
+        ring.write(0, {"x": np.zeros((5, 3), np.float32),
+                       "y": np.zeros((4,), np.int64)})
+    finally:
+      ring.close()
+
+
+class TestShmRing:
+
+  def test_roundtrip_and_zero_copy_views(self):
+    ring = ShmRing(LAYOUT, num_slots=2)
+    try:
+      batch = {"x": np.arange(12, dtype=np.float32).reshape(4, 3),
+               "y": np.arange(4, dtype=np.int64)}
+      ring.write(0, batch)
+      views = ring.views(0)
+      np.testing.assert_array_equal(views["x"], batch["x"])
+      np.testing.assert_array_equal(views["y"], batch["y"])
+      # Views ALIAS the segment: a second write to the same slot is
+      # visible through previously returned views (which is exactly
+      # why the consumer must not hold them past slot recycling).
+      ring.write(0, {"x": np.full((4, 3), 9, np.float32),
+                     "y": np.full((4,), 9, np.int64)})
+      assert float(views["x"][0, 0]) == 9.0
+    finally:
+      ring.close()
+
+
+class TestHostDataPlane:
+
+  def test_finite_stream_all_batches_then_stopiteration(self):
+    plane = HostDataPlane(CountSource(10), LAYOUT, num_workers=2,
+                          copy=True)
+    try:
+      got = sorted(int(b["x"][0, 0]) for b in plane)
+      assert got == list(range(10))
+      with pytest.raises(StopIteration):
+        next(plane)
+    finally:
+      plane.close()
+
+  def test_single_worker_preserves_order(self):
+    plane = HostDataPlane(CountSource(6), LAYOUT, num_workers=1,
+                          copy=False)
+    try:
+      assert [int(next(plane)["x"][0, 0]) for _ in range(6)] == \
+          list(range(6))
+    finally:
+      plane.close()
+
+  def test_worker_crash_mid_batch_reraises_and_latches(self):
+    plane = HostDataPlane(CrashSource(), LAYOUT, num_workers=1,
+                          copy=True)
+    try:
+      next(plane)  # the good batch
+      with pytest.raises(RuntimeError, match="boom from worker 0"):
+        next(plane)
+      # Latched: every later pull re-raises instead of hanging.
+      with pytest.raises(RuntimeError):
+        next(plane)
+    finally:
+      plane.close()
+
+  def test_worker_hard_death_detected(self):
+    plane = HostDataPlane(HardDeathSource(), LAYOUT, num_workers=1,
+                          copy=True)
+    try:
+      # os._exit(3) races the queue feeder thread: the good batch may
+      # or may not have been flushed into the pipe before death, so
+      # the exit-code detection may fire on the first or second pull —
+      # either way it must fire, with the exit code named.
+      with pytest.raises(RuntimeError, match="exit code 3"):
+        next(plane)
+        next(plane)
+      # And latch: the stream is dead from here on, never hanging.
+      with pytest.raises(RuntimeError):
+        next(plane)
+    finally:
+      plane.close()
+
+  def test_worker_silent_exit0_death_detected(self):
+    # os._exit(0) mid-stream: no exception message, no done marker,
+    # and a CLEAN exit code — the consumer must still latch a death
+    # (after one confirmation poll window for the marker-flush race)
+    # instead of waiting on the full queue forever.
+    plane = HostDataPlane(SilentExitSource(), LAYOUT, num_workers=1,
+                          copy=True)
+    try:
+      with pytest.raises(RuntimeError, match="without sending"):
+        next(plane)
+        next(plane)
+      with pytest.raises(RuntimeError):  # and it latches
+        next(plane)
+    finally:
+      plane.close()
+
+  def test_worker_crash_detected_while_siblings_keep_queue_busy(self):
+    # Worker 1 is hard-killed while worker 0 streams forever: the full
+    # queue never goes empty, so detection must NOT depend on the
+    # empty-window poll — a crashed worker means its file shard
+    # silently stops being produced, which must surface as an error,
+    # not as biased data.
+    plane = HostDataPlane(DieWhileSiblingsProduceSource(), LAYOUT,
+                          num_workers=2, copy=True)
+    try:
+      with pytest.raises(RuntimeError, match="exit code 5"):
+        for _ in range(100):  # span the 0.5s poll gate, queue kept full
+          next(plane)
+          time.sleep(0.02)
+      with pytest.raises(RuntimeError):  # and it latches
+        next(plane)
+    finally:
+      plane.close()
+
+  def test_close_while_workers_blocked_on_full_ring(self):
+    # 1000 pending batches against a tiny ring: both workers are
+    # parked waiting for free slots when close() lands.
+    plane = HostDataPlane(CountSource(1000), LAYOUT, num_workers=2,
+                          copy=True)
+    next(plane)
+    time.sleep(0.3)  # let workers fill the ring and block
+    plane.close()
+    assert plane.workers_alive() == 0
+    with pytest.raises(StopIteration):
+      next(plane)
+
+  def test_close_is_idempotent(self):
+    plane = HostDataPlane(CountSource(4), LAYOUT, num_workers=1,
+                          copy=True)
+    plane.close()
+    plane.close()
+    assert plane.workers_alive() == 0
+
+
+def _write_image_dataset(tmp, num_files=4, per_file=48):
+  spec = TensorSpecStruct()
+  spec.image = ExtendedTensorSpec(shape=(16, 16, 3), dtype=np.uint8,
+                                  name="image", data_format="jpeg")
+  spec.action = ExtendedTensorSpec(shape=(4,), dtype=np.float32,
+                                   name="action")
+  rng = np.random.default_rng(0)
+  for f in range(num_files):
+    write_tfrecord(
+        os.path.join(tmp, f"part-{f}.tfrecord"),
+        [{"image": rng.integers(0, 255, (16, 16, 3)).astype(np.uint8),
+          "action": rng.standard_normal(4).astype(np.float32)}
+         for _ in range(per_file)],
+        spec)
+  return spec, os.path.join(tmp, "part-*.tfrecord")
+
+
+def _collect(spec, pattern, num_workers, n, batch_size=16):
+  gen = TFRecordInputGenerator(
+      file_patterns=pattern, batch_size=batch_size,
+      shuffle_buffer_size=64, seed=7, num_workers=num_workers)
+  gen.set_specification(spec, None)
+  stream = gen.create_dataset(Mode.TRAIN)
+  try:
+    out = []
+    for _ in range(n):
+      features, labels = next(stream)
+      assert labels is None
+      out.append({k: np.array(v)
+                  for k, v in features.to_flat_dict().items()})
+    return out
+  finally:
+    closer = getattr(stream, "close", None)
+    if closer is not None:
+      closer()
+
+
+class TestGeneratorThroughPlane:
+
+  def test_num_workers_0_and_1_bitwise_identical(self, tmp_path):
+    """THE determinism pin: the plane with one worker reproduces the
+    in-process stream bit for bit under a fixed seed (same file
+    order, same tf.data graph, same shuffle seeds — the ring is a
+    pure transport)."""
+    spec, pattern = _write_image_dataset(str(tmp_path))
+    base = _collect(spec, pattern, num_workers=0, n=5)
+    plane = _collect(spec, pattern, num_workers=1, n=5)
+    assert len(base) == len(plane)
+    for a, b in zip(base, plane):
+      assert sorted(a) == sorted(b)
+      for key in a:
+        np.testing.assert_array_equal(a[key], b[key])
+
+  @pytest.mark.slow
+  def test_two_workers_stream_conforming_batches(self, tmp_path):
+    spec, pattern = _write_image_dataset(str(tmp_path))
+    batches = _collect(spec, pattern, num_workers=2, n=4)
+    for batch in batches:
+      assert batch["image"].shape == (16, 16, 16, 3)
+      assert batch["image"].dtype == np.uint8
+      assert batch["action"].shape == (16, 4)
+
+  def test_prefetcher_close_does_not_leak_workers(self):
+    """Abandoning the ShardedPrefetcher mid-stream must tear the
+    whole chain down: prefetcher thread → plane stream → worker
+    PROCESSES → shared segment. (Numpy-source plane: the TF pipeline
+    adds nothing to the teardown path and costs a TF import per
+    spawned worker.)"""
+    import jax
+
+    from tensor2robot_tpu.parallel import create_mesh
+
+    plane = HostDataPlane(CountSource(10_000), LAYOUT, num_workers=2,
+                          copy=True)
+    stream = _PlaneStream(plane, lambda parsed: (parsed, None))
+    mesh = create_mesh({"data": 1}, devices=jax.devices()[:1])
+    prefetcher = ShardedPrefetcher(stream, make_data_sharding(mesh),
+                                   buffer_size=2)
+    next(prefetcher)  # the chain is live: worker → ring → device
+    prefetcher.close()
+    assert _wait_workers_dead(plane) == 0
+
+  def test_prefetcher_close_unblocks_stalled_thread(self):
+    """close() while the prefetch thread is BLOCKED inside the plane's
+    __next__ (stalled worker — slow decode, loaded host) must still
+    tear the chain down: closing the source cross-thread unblocks the
+    thread, so neither it nor the worker processes leak."""
+    import jax
+
+    from tensor2robot_tpu.parallel import create_mesh
+
+    plane = HostDataPlane(StallSource(n=1), LAYOUT, num_workers=1,
+                          copy=True)
+    stream = _PlaneStream(plane, lambda parsed: (parsed, None))
+    mesh = create_mesh({"data": 1}, devices=jax.devices()[:1])
+    prefetcher = ShardedPrefetcher(stream, make_data_sharding(mesh),
+                                   buffer_size=1)
+    next(prefetcher)  # batch 1 consumed; the thread now blocks on 2
+    time.sleep(0.3)   # let it reach the blocking full-queue poll
+    prefetcher.close(timeout_secs=0.5)
+    assert _wait_workers_dead(plane) == 0
+    prefetcher._thread.join(timeout=5.0)
+    assert not prefetcher._thread.is_alive()
+
+  def test_stack_batches_closes_inner_stream(self):
+    plane = HostDataPlane(CountSource(1000), LAYOUT, num_workers=1,
+                          copy=False)
+    stream = _PlaneStream(plane, lambda parsed: (parsed, None))
+    stream.require_copies()  # the stacking contract
+    assert not stream.release_after_transfer
+    stacked = stack_batches(stream, 2)
+    features, _ = next(stacked)
+    assert features.to_flat_dict()["x"].shape == (2, 4, 3)
+    stacked.close()
+    assert _wait_workers_dead(plane) == 0
+
+  @pytest.mark.slow
+  def test_episode_generator_through_plane(self, tmp_path):
+    spec = TensorSpecStruct()
+    spec.obs = ExtendedTensorSpec(shape=(3,), dtype=np.float32,
+                                  name="obs", is_sequence=True)
+    spec.task = ExtendedTensorSpec(shape=(2,), dtype=np.float32,
+                                   name="task")
+    rng = np.random.default_rng(1)
+    path = os.path.join(str(tmp_path), "episodes.tfrecord")
+    write_episode_tfrecord(
+        path,
+        [{"obs": rng.standard_normal((t, 3)).astype(np.float32),
+          "task": rng.standard_normal(2).astype(np.float32)}
+         for t in (3, 5, 4, 6, 2, 5, 4, 3)],
+        spec)
+    gen = TFRecordEpisodeInputGenerator(
+        file_patterns=path, batch_size=4, sequence_length=5,
+        shuffle_buffer_size=8, seed=3, num_workers=1)
+    gen.set_specification(spec, None)
+    stream = gen.create_dataset(Mode.TRAIN)
+    try:
+      features, _ = next(stream)
+      flat = features.to_flat_dict()
+      assert flat["obs"].shape == (4, 5, 3)
+      assert flat["task"].shape == (4, 2)
+      # True pre-pad lengths ride along for masking.
+      assert flat["sequence_length"].shape == (4,)
+      assert flat["sequence_length"].dtype == np.int32
+      assert (flat["sequence_length"] >= 2).all()
+      assert (flat["sequence_length"] <= 5).all()
+    finally:
+      stream.close()

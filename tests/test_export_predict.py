@@ -46,6 +46,7 @@ def trained(tmp_path_factory):
   return model, state, model_dir
 
 
+@pytest.mark.slow
 class TestSavedModelExport:
 
   def test_export_creates_artifact_with_assets(self, trained):

@@ -130,6 +130,7 @@ class TestHeatmap:
                                rtol=1e-5)
 
 
+@pytest.mark.slow
 class TestGrasp2VecEndToEnd:
 
   @pytest.fixture(scope="class")
@@ -255,6 +256,7 @@ class TestGrasp2VecEndToEnd:
 class TestGoalConditionedRewardHandoff:
   """The paper's pipeline: grasp2vec labels goal-conditioned QT-Opt."""
 
+  @pytest.mark.slow
   def test_reward_separates_matched_from_mismatched(self, run=None):
     # Train a quick model inline (class-scoped e2e fixture lives in
     # another class); tiny and fast is enough for separation.

@@ -196,6 +196,7 @@ class TestMAMLTraining:
     # The learnable rate must actually receive outer gradients.
     assert not np.allclose(after, before)
 
+  @pytest.mark.slow
   def test_maml_beats_pre_adaptation_on_sine_tasks(self):
     """The canonical sanity check on random-phase sine regression."""
 
@@ -250,6 +251,7 @@ class TestMAMLTraining:
     assert post < pre * 0.75, (pre, post)
 
 
+@pytest.mark.slow
 class TestPoseEnvMAML:
 
   def test_pose_maml_end_to_end(self, tmp_path):

@@ -105,6 +105,7 @@ def _task_error(policy, rng, with_demos, wrong_demos=False):
   return float(np.mean(errors))
 
 
+@pytest.mark.slow
 class TestMAMLThroughSavedModel:
 
   def test_policy_infers_meta_layout(self, trained_maml):
@@ -146,6 +147,7 @@ class TestMAMLThroughSavedModel:
     assert adapted < zero_shot * 0.8, (adapted, zero_shot)
 
 
+@pytest.mark.slow
 class TestPoseEnvMAMLThroughSavedModel:
   """The research-family MAML (pose_env) through the exported artifact.
 
@@ -253,6 +255,7 @@ class TestPoseEnvMAMLThroughSavedModel:
     assert np.mean(shifts) > 0.3, shifts
 
 
+@pytest.mark.slow
 class TestSNAILThroughSavedModel:
 
   @pytest.fixture(scope="class")

@@ -67,6 +67,7 @@ class TestPipelinedTrunk:
   def mesh(self):
     return create_mesh({DATA_AXIS: 2, STAGE_AXIS: 4})
 
+  @pytest.mark.slow
   def test_matches_sequential_fallback(self, mesh):
     """Same stacked params, pipelined (data×stage mesh) vs the
     sequential-scan fallback (mesh=None): identical outputs AND
@@ -153,6 +154,7 @@ class TestPipelineSharding:
       pipeline_sharding(mesh, tree)
 
 
+@pytest.mark.slow
 class TestPipelinedBCByConfig:
   """The shipped .gin trains the pipelined family end to end."""
 

@@ -67,6 +67,7 @@ class TestPoseEnv:
     assert label.target_pose.shape == (2,)
 
 
+@pytest.mark.slow
 class TestPoseEnvEndToEnd:
 
   @pytest.fixture(scope="class")

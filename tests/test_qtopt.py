@@ -312,6 +312,7 @@ class TestGraspSuccessEval:
   grasping bandit, and the success hook must log per checkpoint.
   """
 
+  @pytest.mark.slow
   def test_policy_learns_to_grasp_and_hook_logs(self, tmp_path):
     from tensor2robot_tpu.hooks import QTOptSuccessEvalHook
     from tensor2robot_tpu.models import optimizers as opt_lib
@@ -404,6 +405,7 @@ class TestOnlineActor:
     assert len(replay) == 64
     assert actor.episodes_collected == 64
 
+  @pytest.mark.slow
   def test_online_loop_learns_from_its_own_data(self, tmp_path):
     """Replay starts EMPTY: the actor's random bootstrap fills it, the
     trainer learns, checkpoints refresh the acting policy, and the
@@ -467,6 +469,10 @@ class TestTrainQTOpt:
     records = [json.loads(line) for line in
                open(os.path.join(model_dir, "metrics_train.jsonl"))]
     assert "grad_steps_per_sec" in records[-1]
+    # Feed-boundness is a logged trainer signal, bounded like a
+    # fraction.
+    for record in records:
+      assert 0.0 <= record["input_wait_fraction"] <= 1.0
     # Checkpoint resumes.
     state2 = train_qtopt(
         learner=learner,

@@ -667,7 +667,7 @@ class TestReplayBenchSmoke:
     assert shard_axis["1"][
         "loaded_goodput_transitions_speedup_vs_1_shard"] == 1.0
     assert shard_axis["2"]["loaded_sample_batches_per_sec"] > 0
-    assert "host_memcpy_2thread_scaling" in detail
+    assert "host_memcpy_scaling" in detail
     actors = detail["throughput_vs_actors"]
     assert actors["1"]["committed_transitions_per_sec"] > 0
     hist = detail["online_staleness"]["histogram"]

@@ -120,6 +120,7 @@ class TestRingAttention:
                                atol=5e-5, rtol=5e-5)
 
   @pytest.mark.parametrize("causal", [False, True])
+  @pytest.mark.slow
   def test_flash_block_gradients_match(self, causal):
     """jax.grad through ring(flash blocks) == reference autodiff.
 

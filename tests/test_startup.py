@@ -330,6 +330,7 @@ class TestPredictorOverlap:
     assert engine.compiled_buckets == (1, 2, 4)
 
 
+@pytest.mark.slow
 class TestColdstartBenchSmoke:
 
   def test_coldstart_dry_run(self):

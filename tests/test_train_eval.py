@@ -65,6 +65,11 @@ def test_train_eval_end_to_end(tmp_path):
   records = [json.loads(l) for l in train_lines]
   assert records[-1]["step"] == 20
   assert "loss" in records[-1] and "steps_per_sec" in records[-1]
+  # The feed-boundness signal rides every train log record: the share
+  # of the interval's wall spent blocked in the prefetcher.
+  for record in records:
+    assert 0.0 <= record["input_wait_fraction"] <= 1.0
+  assert "stall_fraction" in records[-1]
   eval_lines = open(
       os.path.join(model_dir, "metrics_eval.jsonl")).readlines()
   assert len(eval_lines) >= 1

@@ -118,6 +118,7 @@ class TestCausalTransformer:
     with pytest.raises(ValueError, match="mesh"):
       net.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 4)))
 
+  @pytest.mark.slow
   def test_ring_flash_forward_and_gradients_match_reference(self):
     """Train through the pod path: ring over the seq mesh with flash
     blocks (pallas interpreter on CPU). Outputs AND parameter
@@ -192,6 +193,7 @@ def _restored_context_policy(model, model_dir, context_length=16):
                                    context_length=context_length)
 
 
+@pytest.mark.slow
 class TestTransformerBC:
 
   @pytest.fixture(scope="class")
@@ -405,6 +407,7 @@ class TestMoETransformerBC:
     return _train_bc_run(tmp_path_factory, "tf_moe_bc", demo_seed=5,
                          moe_experts=2, moe_every=1)
 
+  @pytest.mark.slow
   def test_moe_clone_closes_the_loop(self, run_moe):
     """Routed-expert BC must actually learn the task, not just run:
     same closed-loop success bar as the dense transformer family."""

@@ -118,6 +118,7 @@ class TestVRGripperBCModels:
     assert not np.allclose(np.asarray(sampled),
                            np.asarray(outputs["action"]))
 
+  @pytest.mark.slow
   def test_bc_learns_expert(self):
     # Clone the scripted expert from its own demos; the policy must
     # beat the do-nothing baseline by a wide margin on action error.
@@ -245,6 +246,7 @@ class TestMetaBCModels:
         state, jax.tree_util.tree_map(jnp.asarray, pf))
     assert outputs["action"].shape == (2, 2, 3)
 
+  @pytest.mark.slow
   def test_snail_uses_demonstrations(self):
     # In-context learning sanity: the task is "output the constant
     # action revealed by the demos". A correct SNAIL conditions on the
@@ -317,6 +319,7 @@ class TestWTLModels:
         state, f, l, jax.random.PRNGKey(0))
     assert np.isfinite(float(metrics["loss"]))
 
+  @pytest.mark.slow
   def test_wtl_learns_on_scripted_tasks(self):
     model = VRGripperWTLModel(
         policy_type="retrial", image_size=IMG, filters=(8,),
@@ -383,6 +386,7 @@ class TestShippedConfigs:
       gin.clear_config()
 
 
+@pytest.mark.slow
 class TestVRGripperEndToEnd:
 
   def test_collect_train_eval(self, tmp_path):
