@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# t2rcheck static-analysis gate (docs/ANALYSIS.md).
+#
+# Two stages, fail-fast ordering:
+#   1. Pure-AST families (jax tracing hazards, concurrency/lifecycle,
+#      worker import hygiene) — runs WITHOUT importing jax, asserted:
+#      a hazard in the data-plane/serving code costs ~a second to
+#      catch, not a jax+XLA import. This is also the path that stays
+#      usable inside plane-worker-safe tooling.
+#   2. Gin static validation — resolves every shipped .gin binding
+#      against real configurable signatures, which requires importing
+#      the configurable families (and therefore jax).
+#
+# Exit codes: 0 clean, 1 findings, 2 usage/baseline error, 3 the
+# no-jax-import invariant of stage 1 broke.
+#
+# Usage: scripts/lint.sh   (from the repo root)
+set -u
+cd "$(dirname "$0")/.."
+
+echo "--- t2rcheck stage 1: AST linters (no jax import) ---"
+python - <<'EOF'
+import sys
+
+from tensor2robot_tpu.analysis.cli import main
+
+rc = main(["--checks", "jax,concurrency,imports"])
+if "jax" in sys.modules:
+    print("lint.sh: the AST lint path imported jax — the fast-path "
+          "invariant broke (see analysis/__init__.py)", file=sys.stderr)
+    rc = rc or 3
+sys.exit(rc)
+EOF
+rc=$?
+if [ "$rc" -ne 0 ]; then exit "$rc"; fi
+
+echo "--- t2rcheck stage 2: gin static validation ---"
+env JAX_PLATFORMS=cpu python -m tensor2robot_tpu.analysis --checks gin
+exit $?

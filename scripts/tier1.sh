@@ -10,6 +10,13 @@
 set -u
 cd "$(dirname "$0")/.."
 
+# Static analysis FIRST: a gin typo or a concurrency hazard fails in
+# seconds here instead of minutes into the pytest run (ISSUE 5).
+echo "--- t2rcheck static analysis (scripts/lint.sh) ---"
+scripts/lint.sh
+lint_rc=$?
+if [ "$lint_rc" -ne 0 ]; then exit "$lint_rc"; fi
+
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 
 echo "--- serving bench smoke (bench.py --serving --dry-run) ---"

@@ -1,0 +1,34 @@
+"""t2rcheck — repo-native static analysis for tensor2robot_tpu.
+
+Three checker families, one CLI (``python -m tensor2robot_tpu.analysis``):
+
+  * ``gin``         — static validation of shipped ``.gin`` configs
+                      against real configurable signatures (no training
+                      executed). Rules ``GIN1xx``.
+  * ``jax``         — tracing-hazard linting of functions reachable
+                      under ``jax.jit`` / ``shard_map`` / ``scan`` /
+                      AOT-lowered entry points. Rules ``JAX2xx``.
+  * ``concurrency`` — blocking-call-under-lock, queue-timeout,
+                      lock-acquisition-order and resource-lifecycle
+                      linting over the concurrency-heavy subsystems.
+                      Rules ``CON3xx``.
+  * ``imports``     — import hygiene for plane-worker-safe modules
+                      (must never pull jax at import time). ``IMP4xx``.
+
+Everything except the ``gin`` family is pure ``ast`` — importing this
+package (and running those checks) never imports jax, which is what
+lets ``scripts/lint.sh`` fail fast before any heavyweight import.
+
+Findings carry rule IDs; suppress intentional ones inline with
+``# t2rcheck: disable=RULE`` (same line or the line above) and park
+legacy debt in a committed baseline file (see docs/ANALYSIS.md).
+"""
+
+from tensor2robot_tpu.analysis.findings import (
+    Baseline,
+    Finding,
+    PragmaIndex,
+    RULE_CATALOG,
+)
+
+__all__ = ["Baseline", "Finding", "PragmaIndex", "RULE_CATALOG"]

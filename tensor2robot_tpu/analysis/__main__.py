@@ -1,0 +1,8 @@
+"""`python -m tensor2robot_tpu.analysis` → the t2rcheck CLI."""
+
+import sys
+
+from tensor2robot_tpu.analysis.cli import main
+
+if __name__ == "__main__":
+  sys.exit(main())

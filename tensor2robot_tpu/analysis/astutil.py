@@ -1,0 +1,273 @@
+"""Shared AST plumbing for the pure-static t2rcheck families.
+
+Everything here is stdlib-`ast` only — no imports of the analyzed code,
+no jax. The linters' precision comes from a handful of shared
+resolutions:
+
+  * `dotted_name(node)` — the best-effort dotted form of a call target
+    (`"self._queue.put"`, `"jax.lax.scan"`, `"time.sleep"`), with
+    `Attribute`/`Name` chains flattened and everything else opaque.
+  * `Module` — one parsed file: functions indexed by qualname, classes
+    with their attribute assignments (so a rule can ask "is
+    `self._queue` a bounded `queue.Queue`?"), import aliases resolved
+    to full module paths.
+  * `iter_files` — the repo walker every family shares (skips caches,
+    never follows tests unless asked).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+  """`a.b.c` for Name/Attribute chains; None for anything dynamic."""
+  parts: List[str] = []
+  while isinstance(node, ast.Attribute):
+    parts.append(node.attr)
+    node = node.value
+  if isinstance(node, ast.Name):
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+  if isinstance(node, ast.Call):
+    # `foo(...).bar` — resolve through the call for patterns like
+    # `multiprocessing.get_context("spawn").Queue`.
+    inner = dotted_name(node.func)
+    if inner and parts:
+      return inner + "()." + ".".join(reversed(parts))
+    return inner
+  return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+  return dotted_name(call.func)
+
+
+def has_keyword(call: ast.Call, name: str) -> bool:
+  return any(kw.arg == name for kw in call.keywords)
+
+
+def keyword_value(call: ast.Call, name: str) -> Optional[ast.AST]:
+  for kw in call.keywords:
+    if kw.arg == name:
+      return kw.value
+  return None
+
+
+class FunctionInfo:
+  """One function/method with the context rules need."""
+
+  def __init__(self, node: ast.AST, qualname: str,
+               class_name: Optional[str]):
+    self.node = node
+    self.qualname = qualname          # "Class.method" or "func"
+    self.name = node.name
+    self.class_name = class_name
+    self.params = [a.arg for a in (
+        list(node.args.posonlyargs) + list(node.args.args)
+        + list(node.args.kwonlyargs))]
+    if self.params and self.params[0] in ("self", "cls"):
+      self.params = self.params[1:]
+    self.decorators = [dotted_name(d) or dotted_name(getattr(d, "func",
+                                                             d)) or ""
+                       for d in node.decorator_list]
+
+  @property
+  def lineno(self) -> int:
+    return self.node.lineno
+
+
+class ClassInfo:
+  """Attribute assignments (`self.x = <expr>`) aggregated per class."""
+
+  def __init__(self, name: str):
+    self.name = name
+    # attr -> list of value expressions assigned to self.<attr>
+    self.self_assignments: Dict[str, List[ast.AST]] = {}
+    self.method_names: List[str] = []
+
+
+class Module:
+  """One parsed source file, indexed for the rule implementations."""
+
+  def __init__(self, path: str, rel: str, tree: ast.Module,
+               source: str):
+    self.path = path
+    self.rel = rel
+    self.tree = tree
+    self.source = source
+    self.functions: Dict[str, FunctionInfo] = {}
+    self.classes: Dict[str, ClassInfo] = {}
+    # local alias -> full module path ("np" -> "numpy",
+    # "shard_map" -> "jax.experimental.shard_map.shard_map").
+    self.import_aliases: Dict[str, str] = {}
+    # module-level `import x` / `from x import y` targets, full paths.
+    self.module_imports: List[str] = []
+    self._index()
+
+  # ---- indexing ----
+
+  def _index(self) -> None:
+    for node in self.tree.body:
+      self._index_imports(node, top_level=True)
+    for node in ast.walk(self.tree):
+      if isinstance(node, (ast.Import, ast.ImportFrom)):
+        self._index_imports(node, top_level=False)
+
+    def visit(node: ast.AST, class_name: Optional[str],
+              prefix: str) -> None:
+      for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+          qual = f"{prefix}{child.name}"
+          self.functions[qual] = FunctionInfo(child, qual, class_name)
+          if class_name and class_name in self.classes:
+            self.classes[class_name].method_names.append(child.name)
+          visit(child, class_name, qual + ".")
+        elif isinstance(child, ast.ClassDef):
+          info = ClassInfo(child.name)
+          self.classes[child.name] = info
+          visit(child, child.name, child.name + ".")
+        else:
+          visit(child, class_name, prefix)
+
+    visit(self.tree, None, "")
+
+    for cls in self.classes.values():
+      for method in (f for q, f in self.functions.items()
+                     if f.class_name == cls.name):
+        for node in ast.walk(method.node):
+          targets = ()
+          if isinstance(node, ast.Assign):
+            targets = node.targets
+          elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = (node.target,)
+          for target in targets:
+            if (isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"):
+              cls.self_assignments.setdefault(
+                  target.attr, []).append(node.value)
+
+  def _index_imports(self, node: ast.AST, top_level: bool) -> None:
+    if isinstance(node, ast.Import):
+      for alias in node.names:
+        local = alias.asname or alias.name.split(".")[0]
+        full = alias.name if alias.asname else alias.name.split(".")[0]
+        self.import_aliases[local] = full
+        if top_level:
+          self.module_imports.append(alias.name)
+    elif isinstance(node, ast.ImportFrom) and node.module:
+      for alias in node.names:
+        local = alias.asname or alias.name
+        self.import_aliases[local] = f"{node.module}.{alias.name}"
+        if top_level:
+          # Both forms: `from pkg import sub` executes pkg/__init__
+          # AND (when sub is a module) sub itself — the import-closure
+          # walk resolves each against real files and skips non-modules.
+          self.module_imports.append(node.module)
+          self.module_imports.append(f"{node.module}.{alias.name}")
+
+  # ---- resolution ----
+
+  def expand(self, name: Optional[str]) -> Optional[str]:
+    """Rewrites a dotted name's head through the import aliases:
+    `np.random.seed` → `numpy.random.seed`."""
+    if not name:
+      return name
+    head, _, rest = name.partition(".")
+    full = self.import_aliases.get(head)
+    if full is None:
+      return name
+    return f"{full}.{rest}" if rest else full
+
+  def enclosing_function(self, node: ast.AST) -> Optional[FunctionInfo]:
+    best = None
+    for info in self.functions.values():
+      fn = info.node
+      if (fn.lineno <= node.lineno
+          and node.lineno <= (fn.end_lineno or fn.lineno)):
+        if best is None or fn.lineno > best.node.lineno:
+          best = info
+    return best
+
+
+def resolve_callee(by_dotted: Dict[str, "Module"], module: "Module",
+                   func: Optional[FunctionInfo], call: ast.Call
+                   ) -> Optional[Tuple["Module", str]]:
+  """(module, qualname) of a call target, when statically resolvable.
+
+  Shared by the jax-reachability and lock-order analyses: bare names
+  resolve in the defining module, ``self.x`` in the enclosing class,
+  ``alias.fn`` through the import table into the analyzed tree.
+  Dynamic dispatch resolves to None (the analyses under-approximate).
+  """
+  name = dotted_name(call.func)
+  if not name:
+    return None
+  if "." not in name:
+    if name in module.functions:
+      return module, name
+    return None
+  head, _, rest = name.partition(".")
+  if head == "self" and func is not None and func.class_name \
+      and "." not in rest:
+    qual = f"{func.class_name}.{rest}"
+    if qual in module.functions:
+      return module, qual
+    return None
+  expanded = module.expand(name)
+  if expanded and "." in expanded:
+    mod_path, _, fn_name = expanded.rpartition(".")
+    target = by_dotted.get(mod_path)
+    if target and fn_name in target.functions:
+      return target, fn_name
+  return None
+
+
+def modules_by_dotted_path(modules: Sequence["Module"]
+                           ) -> Dict[str, "Module"]:
+  by_dotted: Dict[str, Module] = {}
+  for m in modules:
+    dotted = m.rel[:-3] if m.rel.endswith(".py") else m.rel
+    by_dotted[dotted.replace("/", ".")] = m
+  return by_dotted
+
+
+def parse_module(path: str, root: str) -> Optional[Module]:
+  from tensor2robot_tpu.analysis.findings import rel_path
+  try:
+    with open(path, encoding="utf-8") as f:
+      source = f.read()
+    tree = ast.parse(source, filename=path)
+  except (OSError, SyntaxError):
+    return None
+  return Module(path, rel_path(path, root), tree, source)
+
+
+_SKIP_DIRS = {"__pycache__", ".git", "node_modules", ".claude"}
+
+
+def iter_files(paths: Sequence[str], suffix: str = ".py"
+               ) -> Iterator[str]:
+  """Expands files/directories into a deterministic file list."""
+  for path in paths:
+    if os.path.isfile(path):
+      if path.endswith(suffix):
+        yield path
+      continue
+    for dirpath, dirnames, filenames in os.walk(path):
+      dirnames[:] = sorted(d for d in dirnames if d not in _SKIP_DIRS)
+      for name in sorted(filenames):
+        if name.endswith(suffix):
+          yield os.path.join(dirpath, name)
+
+
+def parse_tree(paths: Sequence[str], root: str) -> List[Module]:
+  modules = []
+  for path in iter_files(paths):
+    mod = parse_module(path, root)
+    if mod is not None:
+      modules.append(mod)
+  return modules

@@ -1,0 +1,580 @@
+"""Concurrency & lifecycle linter (rules CON301–CON304). Pure AST.
+
+The four concurrency-heavy subsystems (replay, serving, data, startup)
+share a failure vocabulary this linter makes checkable:
+
+  * CON301 — a blocking call (``time.sleep``, file/socket I/O,
+    ``subprocess``, an untimed queue op, a thread/process ``join``)
+    executed while a ``threading`` lock is held. Every sampler/writer
+    contending on that lock stalls behind an unbounded wait.
+  * CON302 — a blocking ``queue.get``/``put`` with no timeout anywhere
+    (lock or not): the consumer has no way to notice a dead producer or
+    a close() and hangs forever. Puts on provably-unbounded queues
+    (``queue.Queue()`` with no maxsize, multiprocessing queues) never
+    block and are exempt.
+  * CON303 — a cycle in the cross-module lock-acquisition-order graph.
+    Edges come from lexical nesting (``with A: ... with B:`` /
+    ``B.acquire()``) AND from calls: a function that holds lock A and
+    calls (statically resolvably) a function that eventually acquires
+    B contributes A→B. A cycle means two threads can deadlock.
+  * CON304 — a ``SharedMemory`` / ``ShmRing`` / ``Process`` / ``Popen``
+    creation site with no reachable release path: not stored on an
+    instance whose class defines ``close``/``__del__``/``__exit__``-
+    style teardown, not guarded by ``try/finally`` or ``with``, not
+    returned to a caller (ownership transfer).
+
+Lock identification is deliberately two-pronged: an attribute whose
+class assigns it a ``threading.Lock()``/``RLock()``/``Condition()``
+counts structurally; any name whose last component matches
+``lock``/``mutex`` counts nominally (so locks passed across functions
+still register). Nominal matching is what makes the lock-order graph
+CROSS-module without whole-program type inference.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tensor2robot_tpu.analysis.astutil import (
+    FunctionInfo,
+    Module,
+    dotted_name,
+    has_keyword,
+    modules_by_dotted_path,
+    parse_tree,
+    resolve_callee,
+)
+from tensor2robot_tpu.analysis.findings import Finding
+
+_LOCK_NAME_RE = re.compile(r"(?:^|_)(?:lock|mutex)$", re.IGNORECASE)
+_LOCK_CTORS = {"threading.Lock", "threading.RLock",
+               "threading.Condition", "Lock", "RLock", "Condition"}
+
+_QUEUE_CTORS = {"queue.Queue", "Queue", "queue.LifoQueue",
+                "queue.PriorityQueue", "queue.SimpleQueue"}
+_MP_QUEUE_SUFFIXES = (".Queue", ".SimpleQueue", ".JoinableQueue")
+
+_BLOCKING_EXACT = {
+    "time.sleep",
+    "os.makedirs", "os.replace", "os.rename",
+    "shutil.copy", "shutil.copytree", "shutil.rmtree",
+    "numpy.savez", "numpy.save", "numpy.load",
+    "json.dump", "json.load",
+    # Device round-trips and XLA compilation: seconds-long waits that
+    # serialize every contender behind the lock.
+    "jax.block_until_ready", "jax.device_put", "jax.device_get",
+}
+_BLOCKING_PREFIXES = ("subprocess.", "socket.", "requests.",
+                      "urllib.")
+_BLOCKING_SUFFIXES = (".block_until_ready",)
+# `.compile` only counts when the receiver is recognizably a jit/AOT
+# object (`self._jitted.lower(...).compile()`) — a bare suffix match
+# would flag microsecond `re.compile(...)` calls under a lock.
+_COMPILE_RECEIVER_RE = re.compile(r"jit|lower|aot|exec", re.IGNORECASE)
+_JOINABLE_RE = re.compile(
+    r"(?:thread|proc|process|worker|writer|pool)", re.IGNORECASE)
+
+_RESOURCE_SUFFIXES = ("SharedMemory", "ShmRing", "ShmRing.attach",
+                      "Popen", "Process")
+_TEARDOWN_METHODS = {"close", "__del__", "__exit__", "shutdown",
+                     "stop", "terminate", "join", "unlink",
+                     "release_all"}
+_CLOSE_CALL_RE = re.compile(
+    r"close|terminate|kill|join|unlink|shutdown|stop|release")
+
+
+def _last_component(name: str) -> str:
+  return name.rsplit(".", 1)[-1]
+
+
+class _ModuleIndex:
+  """Per-run shared state: modules + class-attribute classifications."""
+
+  def __init__(self, modules: Sequence[Module]):
+    self.modules = list(modules)
+    self.by_dotted = modules_by_dotted_path(self.modules)
+
+  # ---- classification helpers ----
+
+  def is_lock_expr(self, module: Module, func: Optional[FunctionInfo],
+                   expr: ast.AST) -> Optional[str]:
+    """Lock identity string when `expr` denotes a lock, else None.
+
+    Identities: ``Class.attr`` for instance locks (unified across
+    modules by class name — the cross-module graph key),
+    ``module:func:name`` for locals/params.
+    """
+    name = dotted_name(expr)
+    if not name:
+      return None
+    base = _last_component(name)
+    structural = False
+    if name.startswith("self.") and func is not None \
+        and func.class_name:
+      cls = module.classes.get(func.class_name)
+      if cls:
+        for value in cls.self_assignments.get(base, ()):
+          ctor = module.expand(dotted_name(getattr(value, "func",
+                                                   value)))
+          if ctor in _LOCK_CTORS:
+            structural = True
+    if not structural and not _LOCK_NAME_RE.search(base):
+      return None
+    if name.startswith("self.") and func is not None \
+        and func.class_name:
+      return f"{func.class_name}.{base}"
+    if name.startswith("cls.") and func is not None \
+        and func.class_name:
+      return f"{func.class_name}.{base}"
+    if "." in name:
+      # `shard.lock` — keyed by the receiver variable's name, which is
+      # as precise as name-based analysis gets cross-function.
+      return f"{name}"
+    scope = func.qualname if func else "<module>"
+    return f"{module.rel}:{scope}:{name}"
+
+  def queue_boundedness(self, module: Module,
+                        func: Optional[FunctionInfo],
+                        receiver: str) -> Optional[str]:
+    """"bounded" | "unbounded" | None (not provably a queue).
+
+    Resolution: `self.X` receivers look up the class's constructor
+    assignment; bare names fall back to the nominal `*_q` / `*queue*`
+    convention the data plane uses for queues passed into workers.
+    """
+    base = _last_component(receiver)
+    if receiver.startswith("self.") and func is not None \
+        and func.class_name:
+      cls = module.classes.get(func.class_name)
+      if cls:
+        for value in cls.self_assignments.get(base, ()):
+          call = value if isinstance(value, ast.Call) else None
+          if call is None:
+            continue
+          ctor = module.expand(dotted_name(call.func)) or ""
+          if ctor in _QUEUE_CTORS or ctor.endswith(_MP_QUEUE_SUFFIXES):
+            mp_like = ctor.endswith(_MP_QUEUE_SUFFIXES) and \
+                ctor not in _QUEUE_CTORS
+            if mp_like:
+              return "unbounded"  # mp queues: put blocks ~never
+            bounded = bool(call.args) or has_keyword(call, "maxsize")
+            return "bounded" if bounded else "unbounded"
+    if re.search(r"(?:^|_)(?:q|queue)$", base, re.IGNORECASE) \
+        or "queue" in base.lower():
+      # Nominal queue (a `*_q` passed across a function boundary, the
+      # data-plane convention): a GET can always block, but a PUT only
+      # blocks on a bounded queue and mp/default queues are unbounded
+      # — treat as unbounded so puts don't spray false positives.
+      return "unbounded"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# CON301 + CON303: lock regions, blocking calls, acquisition order
+# ---------------------------------------------------------------------------
+
+class _LockScan:
+  """Per-function lock facts feeding CON301 and the CON303 graph."""
+
+  def __init__(self):
+    # locks acquired anywhere in the function body (identity strings).
+    self.acquired: Set[str] = set()
+    # (held_lock, acquired_lock, lineno) lexical nesting edges.
+    self.nested: List[Tuple[str, str, int]] = []
+    # (held_lock, callee_module, callee_qual, lineno) calls under lock.
+    self.calls_under_lock: List[Tuple[str, Module, str, int]] = []
+    # EVERY statically-resolvable call, lock or not: the eventual-
+    # acquires fixpoint must cross lock-free intermediaries (f holds A
+    # and calls g; g holds nothing but calls h which takes B — the
+    # A→B edge only exists if g's call to h is on record).
+    self.calls: List[Tuple[Module, str]] = []
+    # (held_lock, call node, name, lineno) blocking-call candidates.
+    self.blocking: List[Tuple[str, ast.Call, str, int]] = []
+
+
+def _scan_function_locks(index: _ModuleIndex, module: Module,
+                         func: FunctionInfo) -> _LockScan:
+  scan = _LockScan()
+
+  def process(node: ast.AST, held: Tuple[str, ...]) -> None:
+    """Processes ONE node (registering with-locks/calls), recursing
+    with the lock set its body runs under."""
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.Lambda)):
+      return  # a nested def's body doesn't run under this lock
+    if isinstance(node, ast.With):
+      new_held = held
+      for item in node.items:
+        expr = item.context_expr
+        # `with lock:` and `with lock_factory() as ...:` forms.
+        lock_id = index.is_lock_expr(module, func, expr)
+        if lock_id is None and isinstance(expr, ast.Call):
+          lock_id = index.is_lock_expr(module, func, expr.func)
+        if lock_id:
+          scan.acquired.add(lock_id)
+          # Pair against new_held, not held: `with A, B:` acquires in
+          # item order, so B nests under A even within one statement.
+          for outer in new_held:
+            scan.nested.append((outer, lock_id, node.lineno))
+          new_held = new_held + (lock_id,)
+        else:
+          process(expr, new_held)
+      for stmt in node.body:
+        process(stmt, new_held)
+      return
+    if isinstance(node, ast.Call):
+      handle_call(node, held)
+    for child in ast.iter_child_nodes(node):
+      process(child, held)
+
+  def handle_call(call: ast.Call, held: Tuple[str, ...]) -> None:
+    name = dotted_name(call.func) or ""
+    # explicit acquire(): an ordering source even without `with`.
+    if name.endswith(".acquire"):
+      lock_id = index.is_lock_expr(module, func, call.func.value)
+      if lock_id:
+        scan.acquired.add(lock_id)
+        for outer in held:
+          scan.nested.append((outer, lock_id, call.lineno))
+      return
+    resolved = resolve_callee(index.by_dotted, module, func, call)
+    if resolved is not None:
+      scan.calls.append(resolved)
+    for lock_id in held:
+      if resolved is not None:
+        scan.calls_under_lock.append(
+            (lock_id, resolved[0], resolved[1], call.lineno))
+      scan.blocking.append((lock_id, call, name, call.lineno))
+
+  for stmt in func.node.body:
+    process(stmt, ())
+  return scan
+
+
+def _is_blocking_call(index: _ModuleIndex, module: Module,
+                      func: FunctionInfo, call: ast.Call,
+                      name: str) -> Optional[str]:
+  """Reason string when `call` belongs to a blocking class."""
+  expanded = module.expand(name) or name
+  if expanded in _BLOCKING_EXACT or name in _BLOCKING_EXACT:
+    return f"`{name}(...)`"
+  if any(expanded.startswith(p) for p in _BLOCKING_PREFIXES):
+    return f"`{expanded}(...)`"
+  if name.endswith(_BLOCKING_SUFFIXES):
+    return f"`{name}(...)` (device sync / XLA compile)"
+  if name.endswith(".compile") and _COMPILE_RECEIVER_RE.search(
+      name.rsplit(".", 1)[0]):
+    return f"`{name}(...)` (device sync / XLA compile)"
+  if name == "open" or expanded == "open":
+    return "`open(...)` (file I/O)"
+  base = _last_component(name)
+  if base in ("get", "put") and "." in name:
+    receiver = name.rsplit(".", 1)[0]
+    boundedness = index.queue_boundedness(module, func, receiver)
+    if boundedness is not None:
+      if base == "put" and boundedness == "unbounded":
+        return None  # a put on an unbounded queue cannot block
+      if not _queue_op_has_timeout(call):
+        return f"untimed `{name}(...)`"
+      return None
+  if base == "join" and "." in name:
+    receiver = _last_component(name.rsplit(".", 1)[0])
+    if _JOINABLE_RE.search(receiver) and not call.args \
+        and not has_keyword(call, "timeout"):
+      return f"untimed `{name}()`"
+  if base == "wait" and "." in name and not call.args \
+      and not has_keyword(call, "timeout"):
+    receiver = _last_component(name.rsplit(".", 1)[0])
+    if re.search(r"event|cond|condition|barrier", receiver,
+                 re.IGNORECASE):
+      return f"untimed `{name}()`"
+  return None
+
+
+def _queue_op_has_timeout(call: ast.Call) -> bool:
+  name = dotted_name(call.func) or ""
+  if name.endswith(("_nowait",)):
+    return True
+  if has_keyword(call, "timeout"):
+    return True
+  for kw in call.keywords:
+    if kw.arg == "block" and isinstance(kw.value, ast.Constant) \
+        and kw.value.value is False:
+      return True
+  base = _last_component(name)
+  # positional timeout: get(block, timeout) / put(item, block, timeout)
+  needed = 2 if base == "get" else 3
+  return len(call.args) >= needed
+
+
+# ---------------------------------------------------------------------------
+# CON302: untimed queue ops anywhere
+# ---------------------------------------------------------------------------
+
+def _scan_queue_ops(index: _ModuleIndex, module: Module,
+                    findings: List[Finding]) -> None:
+  for node in ast.walk(module.tree):
+    if not isinstance(node, ast.Call):
+      continue
+    name = dotted_name(node.func)
+    if not name or "." not in name:
+      continue
+    base = _last_component(name)
+    if base not in ("get", "put"):
+      continue
+    receiver = name.rsplit(".", 1)[0]
+    func = module.enclosing_function(node)
+    boundedness = index.queue_boundedness(module, func, receiver)
+    if boundedness is None:
+      continue
+    if base == "put" and boundedness == "unbounded":
+      continue  # a put on an unbounded queue cannot block
+    if _queue_op_has_timeout(node):
+      continue
+    scope = func.qualname if func else "<module>"
+    findings.append(Finding(
+        "CON302", module.rel, node.lineno, scope,
+        f"blocking `{name}(...)` with no timeout: the caller cannot "
+        "notice a dead peer or a close() and hangs forever"))
+
+
+# ---------------------------------------------------------------------------
+# CON303: cross-module lock-order graph
+# ---------------------------------------------------------------------------
+
+def _lock_order_cycles(scans: Dict[Tuple[int, str], _LockScan],
+                       funcs: Dict[Tuple[int, str],
+                                   Tuple[Module, FunctionInfo]],
+                       findings: List[Finding]) -> None:
+  # Fixpoint: locks a function eventually acquires (itself+callees).
+  # Propagates through EVERY resolvable call — including lock-free
+  # intermediaries — so a cycle split across hops is still found.
+  eventual: Dict[Tuple[int, str], Set[str]] = {
+      key: set(scan.acquired) for key, scan in scans.items()}
+  changed = True
+  while changed:
+    changed = False
+    for key, scan in scans.items():
+      for callee_mod, callee_qual in scan.calls:
+        callee_key = (id(callee_mod), callee_qual)
+        if callee_key in eventual:
+          before = len(eventual[key])
+          eventual[key] |= eventual[callee_key]
+          if len(eventual[key]) != before:
+            changed = True
+
+  edges: Dict[str, Dict[str, Tuple[str, int]]] = {}
+
+  def add_edge(src: str, dst: str, module: Module, lineno: int):
+    if src == dst:
+      return
+    edges.setdefault(src, {})
+    if dst not in edges[src]:
+      edges[src][dst] = (module.rel, lineno)
+
+  for key, scan in scans.items():
+    module, _ = funcs[key]
+    for held, acquired, lineno in scan.nested:
+      add_edge(held, acquired, module, lineno)
+    for held, callee_mod, callee_qual, lineno in scan.calls_under_lock:
+      callee_key = (id(callee_mod), callee_qual)
+      for dst in eventual.get(callee_key, ()):
+        add_edge(held, dst, module, lineno)
+
+  # DFS cycle detection; each cycle reported once at its first edge.
+  WHITE, GRAY, BLACK = 0, 1, 2
+  color: Dict[str, int] = {}
+  stack: List[str] = []
+  reported: Set[frozenset] = set()
+
+  def dfs(node: str) -> None:
+    color[node] = GRAY
+    stack.append(node)
+    for nxt in edges.get(node, {}):
+      if color.get(nxt, WHITE) == WHITE:
+        dfs(nxt)
+      elif color.get(nxt) == GRAY:
+        cycle = stack[stack.index(nxt):] + [nxt]
+        cycle_key = frozenset(cycle)
+        if cycle_key not in reported:
+          reported.add(cycle_key)
+          rel, lineno = edges[node][nxt]
+          findings.append(Finding(
+              "CON303", rel, lineno, "",
+              "lock-acquisition-order cycle: "
+              + " -> ".join(cycle)
+              + " (two threads entering from opposite ends deadlock)"))
+    stack.pop()
+    color[node] = BLACK
+
+  for node in sorted(edges):
+    if color.get(node, WHITE) == WHITE:
+      dfs(node)
+
+
+# ---------------------------------------------------------------------------
+# CON304: resource lifecycle
+# ---------------------------------------------------------------------------
+
+def _is_resource_ctor(module: Module, call: ast.Call) -> Optional[str]:
+  name = dotted_name(call.func)
+  if not name:
+    return None
+  expanded = module.expand(name) or name
+  for candidate in (name, expanded):
+    if candidate.endswith(_RESOURCE_SUFFIXES) \
+        or _last_component(candidate) in _RESOURCE_SUFFIXES:
+      if _last_component(candidate) in ("Process",) \
+          and not re.search(
+              r"multiprocessing|^ctx\.|context|mp\.",
+              candidate.rsplit(".", 1)[0] or candidate):
+        # `Process` must come from a multiprocessing-ish receiver or a
+        # direct import of multiprocessing.Process.
+        if expanded.split(".")[0] not in ("multiprocessing",):
+          continue
+      return _last_component(candidate)
+  return None
+
+
+def _scan_lifecycle(index: _ModuleIndex, module: Module,
+                    findings: List[Finding]) -> None:
+  for func in module.functions.values():
+    finally_blobs, with_spans = _cleanup_regions(func.node)
+    for node in ast.walk(func.node):
+      if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+          and node is not func.node:
+        continue
+      ctor_calls: List[Tuple[ast.Call, str]] = []
+      target_names: List[str] = []
+      is_self_attr = False
+      if isinstance(node, ast.Assign):
+        for sub in ast.walk(node.value):
+          if isinstance(sub, ast.Call):
+            res = _is_resource_ctor(module, sub)
+            if res:
+              ctor_calls.append((sub, res))
+        for target in node.targets:
+          if isinstance(target, ast.Attribute) and isinstance(
+              target.value, ast.Name) and target.value.id == "self":
+            is_self_attr = True
+          elif isinstance(target, ast.Name):
+            target_names.append(target.id)
+      elif isinstance(node, ast.Expr) and isinstance(node.value,
+                                                     ast.Call):
+        res = _is_resource_ctor(module, node.value)
+        if res:
+          ctor_calls.append((node.value, res))
+      if not ctor_calls:
+        continue
+      for call, res_name in ctor_calls:
+        if any(lo <= call.lineno <= hi for lo, hi in with_spans):
+          continue  # managed by a with-statement
+        if is_self_attr and func.class_name:
+          cls = module.classes.get(func.class_name)
+          if cls and any(f"{func.class_name}.{m}" in module.functions
+                         for m in _TEARDOWN_METHODS):
+            continue
+          findings.append(Finding(
+              "CON304", module.rel, call.lineno, func.qualname,
+              f"`{res_name}` stored on self but class "
+              f"{func.class_name} defines no close()/__del__()/"
+              "__exit__() teardown"))
+          continue
+        if _has_cleanup(func.node, target_names, finally_blobs):
+          continue
+        if _is_returned(func.node, target_names):
+          continue
+        findings.append(Finding(
+            "CON304", module.rel, call.lineno, func.qualname,
+            f"`{res_name}` created with no reachable close()/finally "
+            "path (leaks a process/segment on any exception)"))
+
+
+def _cleanup_regions(fn: ast.AST):
+  """(finally-body sources, with-statement line spans) of a function."""
+  finally_blobs: List[str] = []
+  with_spans: List[Tuple[int, int]] = []
+  for node in ast.walk(fn):
+    if isinstance(node, ast.Try) and node.finalbody:
+      finally_blobs.append(ast.dump(ast.Module(body=node.finalbody,
+                                               type_ignores=[])))
+    if isinstance(node, ast.With):
+      end = node.items[-1].context_expr.end_lineno or node.lineno
+      with_spans.append((node.lineno, end))
+  return finally_blobs, with_spans
+
+
+def _has_cleanup(fn: ast.AST, names: Sequence[str],
+                 finally_blobs: Sequence[str]) -> bool:
+  if not names:
+    # Anonymous expression-statement resource: only a with helps, and
+    # that case was already excluded.
+    return False
+  for blob in finally_blobs:
+    for name in names:
+      if f"id='{name}'" in blob and _CLOSE_CALL_RE.search(blob):
+        return True
+  # `for p in procs: p.close()`-style cleanup where the resource was
+  # appended into a container that a finally tears down.
+  return False
+
+
+def _is_returned(fn: ast.AST, names: Sequence[str]) -> bool:
+  """Ownership transfer = the HANDLE itself is returned (bare name or
+  a container of names). `return shm.name` returns a derived value
+  while dropping the handle — that still leaks."""
+
+  def whole_values(expr: ast.AST):
+    if isinstance(expr, (ast.Tuple, ast.List)):
+      for elt in expr.elts:
+        yield from whole_values(elt)
+    else:
+      yield expr
+
+  for node in ast.walk(fn):
+    if isinstance(node, ast.Return) and node.value is not None:
+      for value in whole_values(node.value):
+        if isinstance(value, ast.Name) and value.id in names:
+          return True
+  return False
+
+
+# ---------------------------------------------------------------------------
+# Entry point
+# ---------------------------------------------------------------------------
+
+def run_concurrency_rules(paths: Sequence[str], root: str
+                          ) -> List[Finding]:
+  modules = parse_tree(paths, root)
+  index = _ModuleIndex(modules)
+  findings: List[Finding] = []
+  scans: Dict[Tuple[int, str], _LockScan] = {}
+  funcs: Dict[Tuple[int, str], Tuple[Module, FunctionInfo]] = {}
+  for module in modules:
+    for qual, func in module.functions.items():
+      scan = _scan_function_locks(index, module, func)
+      key = (id(module), qual)
+      scans[key] = scan
+      funcs[key] = (module, func)
+      for lock_id, call, name, lineno in scan.blocking:
+        reason = _is_blocking_call(index, module, func, call, name)
+        if reason:
+          findings.append(Finding(
+              "CON301", module.rel, lineno, qual,
+              f"{reason} while holding `{lock_id}`: every thread "
+              "contending on that lock stalls behind this wait"))
+    _scan_queue_ops(index, module, findings)
+    _scan_lifecycle(index, module, findings)
+  _lock_order_cycles(scans, funcs, findings)
+  # CON301 may fire once per held lock for one call; dedup by location.
+  seen: Set[Tuple[str, str, int, str]] = set()
+  unique: List[Finding] = []
+  for f in findings:
+    key = (f.rule, f.path, f.line, f.message)
+    if key not in seen:
+      seen.add(key)
+      unique.append(f)
+  unique.sort(key=lambda f: (f.path, f.line, f.rule))
+  return unique

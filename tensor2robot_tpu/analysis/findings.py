@@ -1,0 +1,238 @@
+"""t2rcheck core: findings, the rule catalog, pragmas, and baselines.
+
+A `Finding` is one rule violation at one source location. Its
+FINGERPRINT deliberately excludes the line number — baselines must
+survive unrelated edits shifting code up and down a file — and keys on
+(rule, relative path, enclosing scope, message) instead.
+
+Suppression has two deliberate tiers:
+
+  * inline pragma ``# t2rcheck: disable=RULE[,RULE...]`` on the finding
+    line or the line directly above — for violations that are CORRECT
+    (the comment next to the pragma says why). ``disable=all`` exists
+    for generated code.
+  * the baseline file — for violations that are DEBT: known, tracked,
+    not yet fixed. New code never lands in the baseline; the committed
+    baseline for this repo is empty and the CI gate keeps it that way.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# ---------------------------------------------------------------------------
+# Rule catalog
+# ---------------------------------------------------------------------------
+
+# rule id -> (family, one-line description). The single source of truth:
+# the CLI's --list-rules, the docs table, and the tests all read it.
+RULE_CATALOG: Dict[str, Tuple[str, str]] = {
+    # gin static validator (family "gin")
+    "GIN101": ("gin", "Unknown configurable in binding target"),
+    "GIN102": ("gin", "Bound parameter not in the configurable's "
+                      "signature (and it takes no **kwargs)"),
+    "GIN103": ("gin", "%macro referenced but never defined"),
+    "GIN104": ("gin", "@reference to an unknown configurable"),
+    "GIN105": ("gin", "Bound parameter is denylisted for the "
+                      "configurable"),
+    "GIN106": ("gin", "include/import statement failed to resolve"),
+    "GIN107": ("gin", "Config statement failed to parse"),
+    # JAX tracing-hazard linter (family "jax")
+    "JAX201": ("jax", "Host sync (block_until_ready/.item()/device_get/"
+                      "float(arg)) inside traced code"),
+    "JAX202": ("jax", "Impure call (time.*, np.random.*, print, open, "
+                      "stdlib random) inside traced code"),
+    "JAX203": ("jax", "Python branch on a traced argument inside a "
+                      "jitted function"),
+    "JAX204": ("jax", "Global mutation inside traced code"),
+    # concurrency & lifecycle linter (family "concurrency")
+    "CON301": ("concurrency", "Blocking call (sleep/file/socket/"
+                              "subprocess/untimed queue op/join) while "
+                              "a lock is held"),
+    "CON302": ("concurrency", "Blocking queue get/put with no timeout "
+                              "(consumer can hang forever)"),
+    "CON303": ("concurrency", "Lock-acquisition-order cycle across "
+                              "modules (deadlock-capable)"),
+    "CON304": ("concurrency", "SharedMemory/ShmRing/Process/Popen "
+                              "created without a reachable close()/"
+                              "finally path"),
+    # import hygiene (family "imports")
+    "IMP401": ("imports", "Plane-worker-safe module (transitively) "
+                          "imports jax/tensorflow at module level"),
+}
+
+FAMILIES = ("gin", "jax", "concurrency", "imports")
+
+
+def rules_for_family(family: str) -> List[str]:
+  return [r for r, (fam, _) in RULE_CATALOG.items() if fam == family]
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+  """One rule violation at one source location."""
+
+  rule: str          # e.g. "CON301"
+  path: str          # repo-relative posix path
+  line: int          # 1-based; 0 = whole-file finding
+  scope: str         # enclosing qualname ("Class.method") or ""
+  message: str       # human-readable specifics
+
+  def fingerprint(self) -> str:
+    """Line-number-free stable identity (see module docstring)."""
+    raw = "|".join((self.rule, self.path, self.scope,
+                    _normalize_message(self.message)))
+    return hashlib.sha256(raw.encode("utf-8")).hexdigest()[:16]
+
+  def render(self) -> str:
+    loc = f"{self.path}:{self.line}" if self.line else self.path
+    scope = f" [{self.scope}]" if self.scope else ""
+    return f"{loc}: {self.rule}{scope}: {self.message}"
+
+  def as_dict(self) -> dict:
+    return {
+        "rule": self.rule, "path": self.path, "line": self.line,
+        "scope": self.scope, "message": self.message,
+        "fingerprint": self.fingerprint(),
+    }
+
+
+def _normalize_message(message: str) -> str:
+  """Strips line/col digits so fingerprints survive code motion."""
+  return re.sub(r"\b(line|lineno|col)\s*\d+", r"\1", message)
+
+
+def rel_path(path: str, root: str) -> str:
+  """Repo-relative posix form — the canonical `Finding.path`."""
+  try:
+    rel = os.path.relpath(os.path.abspath(path), os.path.abspath(root))
+  except ValueError:  # different drive (windows); keep absolute
+    rel = path
+  return rel.replace(os.sep, "/")
+
+
+# ---------------------------------------------------------------------------
+# Inline pragmas
+# ---------------------------------------------------------------------------
+
+_PRAGMA_RE = re.compile(
+    r"#\s*t2rcheck:\s*(disable(?:-file)?)\s*=\s*"
+    r"([A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)")
+
+
+class PragmaIndex:
+  """Per-file map of `# t2rcheck: disable=...` suppressions.
+
+  A line pragma suppresses findings on its OWN line and on the line
+  DIRECTLY BELOW it (so a standalone pragma comment can sit above a
+  long statement). ``disable-file=RULE`` anywhere in the file
+  suppresses that rule for the whole file; ``all`` matches every rule.
+  """
+
+  def __init__(self, source: str):
+    self._line_rules: Dict[int, set] = {}
+    self._file_rules: set = set()
+    for lineno, line in enumerate(source.splitlines(), start=1):
+      m = _PRAGMA_RE.search(line)
+      if not m:
+        continue
+      rules = {r.strip().upper() for r in m.group(2).split(",")}
+      if m.group(1) == "disable-file":
+        self._file_rules |= rules
+      else:
+        self._line_rules.setdefault(lineno, set()).update(rules)
+        self._line_rules.setdefault(lineno + 1, set()).update(rules)
+
+  def suppresses(self, rule: str, line: int) -> bool:
+    rule = rule.upper()
+    if "ALL" in self._file_rules or rule in self._file_rules:
+      return True
+    at_line = self._line_rules.get(line, ())
+    return "ALL" in at_line or rule in at_line
+
+  @classmethod
+  def for_file(cls, path: str) -> "PragmaIndex":
+    try:
+      with open(path, encoding="utf-8") as f:
+        return cls(f.read())
+    except OSError:
+      return cls("")
+
+
+def apply_pragmas(findings: Iterable[Finding], root: str
+                  ) -> Tuple[List[Finding], List[Finding]]:
+  """Splits findings into (active, suppressed) using per-file pragmas."""
+  cache: Dict[str, PragmaIndex] = {}
+  active: List[Finding] = []
+  suppressed: List[Finding] = []
+  for finding in findings:
+    index = cache.get(finding.path)
+    if index is None:
+      index = PragmaIndex.for_file(os.path.join(root, finding.path))
+      cache[finding.path] = index
+    if index.suppresses(finding.rule, finding.line):
+      suppressed.append(finding)
+    else:
+      active.append(finding)
+  return active, suppressed
+
+
+# ---------------------------------------------------------------------------
+# Baseline file
+# ---------------------------------------------------------------------------
+
+BASELINE_VERSION = 1
+DEFAULT_BASELINE = "t2rcheck_baseline.json"
+
+
+class Baseline:
+  """The committed ledger of known-and-tolerated finding fingerprints."""
+
+  def __init__(self, fingerprints: Optional[Sequence[str]] = None,
+               entries: Optional[List[dict]] = None):
+    self.fingerprints = set(fingerprints or ())
+    self.entries = list(entries or [])
+
+  @classmethod
+  def load(cls, path: str) -> "Baseline":
+    if not os.path.exists(path):
+      return cls()
+    with open(path, encoding="utf-8") as f:
+      data = json.load(f)
+    if data.get("version") != BASELINE_VERSION:
+      raise ValueError(
+          f"baseline {path!r} has version {data.get('version')!r}; "
+          f"this tool writes version {BASELINE_VERSION}")
+    entries = data.get("findings", [])
+    return cls([e["fingerprint"] for e in entries], entries)
+
+  def write(self, path: str, findings: Sequence[Finding]) -> None:
+    payload = {
+        "version": BASELINE_VERSION,
+        "comment": ("Known t2rcheck findings tolerated as tracked debt. "
+                    "Keep EMPTY: fix or pragma instead of baselining. "
+                    "Regenerate with "
+                    "`python -m tensor2robot_tpu.analysis "
+                    "--write-baseline`."),
+        "findings": sorted((f.as_dict() for f in findings),
+                           key=lambda d: (d["path"], d["rule"],
+                                          d["line"])),
+    }
+    with open(path, "w", encoding="utf-8") as f:
+      json.dump(payload, f, indent=2, sort_keys=False)
+      f.write("\n")
+
+  def split(self, findings: Iterable[Finding]
+            ) -> Tuple[List[Finding], List[Finding]]:
+    """(new, baselined) — only NEW findings fail the gate."""
+    new: List[Finding] = []
+    known: List[Finding] = []
+    for finding in findings:
+      (known if finding.fingerprint() in self.fingerprints
+       else new).append(finding)
+    return new, known

@@ -1,0 +1,271 @@
+"""Gin static validator (rules GIN101–GIN107): validate-only parsing.
+
+The Estimator-era failure this kills: a typo'd binding param
+(`TFRecordInputGenerator.num_wokers = 2`) parses fine, sits inert
+through checkpoint restore and input spin-up, and only explodes —
+or worse, silently no-ops — minutes into a training run. The
+validator resolves every statement of every shipped ``.gin`` config
+against the REAL configurable registry without executing any
+training:
+
+  * binding targets (`scope/module.fn.param`) must name a registered
+    configurable (lazy-registration aware: `register_lazy_configurables`
+    makes the first reference import the defining module, exactly as
+    config parsing would) whose signature has the param — or takes
+    ``**kwargs``;
+  * ``@ref`` values (anywhere inside containers) must resolve to a
+    configurable; ``%macro`` values must be defined somewhere in the
+    config's include closure (order-free, matching call-time macro
+    resolution);
+  * ``include``/``import`` statements must resolve through the same
+    search order the runtime uses.
+
+Registration context mirrors ``bin/run_t2r_trainer``: the same
+``_DEFAULT_MODULES`` are imported before validation, so "valid" here
+means "valid for the production entry point", not "valid for whatever
+happens to be imported". This family is the one t2rcheck path that
+imports the framework (and therefore jax) — ``scripts/lint.sh`` runs
+it after the pure-AST families.
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+from typing import List, Sequence, Set, Tuple
+
+from tensor2robot_tpu.analysis.findings import Finding, rel_path
+
+# Deferred ginlite imports keep `import tensor2robot_tpu.analysis.
+# gin_check` cheap; the heavy work is ensure_registrations().
+
+
+def ensure_registrations(extra_modules: Sequence[str] = ()) -> List[str]:
+  """Imports the trainer's default configurable families.
+
+  Returns the list of modules that FAILED to import (mirrors the
+  trainer's best-effort semantics for in-tree families).
+  """
+  from tensor2robot_tpu.bin.run_t2r_trainer import _DEFAULT_MODULES
+  failed = []
+  for module in list(_DEFAULT_MODULES) + list(extra_modules):
+    try:
+      importlib.import_module(module)
+    except ImportError:
+      failed.append(module)
+  return failed
+
+
+class _FileContext:
+  def __init__(self, path: str, rel: str):
+    self.path = path
+    self.rel = rel
+
+
+def accepted_parameters(fn) -> Tuple[Set[str], bool]:
+  """(accepted param names, accepts-anything) for a configurable.
+
+  Sharper than runtime injection's flat signature check: this repo's
+  model classes take ``**kwargs`` and forward them up the MRO
+  (`PoseEnvRegressionModel(**kwargs)` → `AbstractT2RModel.__init__`),
+  where an unknown key is a TypeError — at construction time, minutes
+  into a run. The validator walks the MRO, unioning each
+  ``__init__``'s named params, and only treats the configurable as
+  accept-anything if EVERY ``__init__`` in the chain keeps
+  ``**kwargs`` open (i.e. the kwargs genuinely escape analysis).
+  Plain functions fall back to their own signature.
+  """
+  import inspect
+
+  def _params_of(target) -> Tuple[Set[str], bool]:
+    try:
+      sig = inspect.signature(target)
+    except (TypeError, ValueError):
+      return set(), True
+    names: Set[str] = set()
+    has_var = False
+    for p in sig.parameters.values():
+      if p.kind == inspect.Parameter.VAR_KEYWORD:
+        has_var = True
+      elif p.kind in (inspect.Parameter.POSITIONAL_OR_KEYWORD,
+                      inspect.Parameter.KEYWORD_ONLY):
+        names.add(p.name)
+    names.discard("self")
+    return names, has_var
+
+  if not inspect.isclass(fn):
+    return _params_of(fn)
+  accepted: Set[str] = set()
+  for klass in fn.__mro__:
+    if klass is object:
+      return accepted, False
+    init = klass.__dict__.get("__init__")
+    if init is None:
+      continue
+    names, has_var = _params_of(init)
+    accepted |= names
+    if not has_var:
+      return accepted, False
+  return accepted, True
+
+
+def validate_config_file(path: str, root: str) -> List[Finding]:
+  """All findings for one top-level config (macro scope = its include
+  closure, matching gin's call-time macro resolution)."""
+  from tensor2robot_tpu.config import ginlite
+
+  findings: List[Finding] = []
+  macros_defined: Set[str] = set()
+  macro_uses: List[Tuple[_FileContext, int, str]] = []
+  visited: Set[str] = set()
+
+  def walk_file(file_path: str) -> None:
+    abs_path = os.path.abspath(file_path)
+    if abs_path in visited:
+      return  # diamond include; already validated
+    visited.add(abs_path)
+    ctx = _FileContext(abs_path, rel_path(abs_path, root))
+    try:
+      with open(abs_path, encoding="utf-8") as f:
+        text = f.read()
+    except OSError as e:
+      findings.append(Finding(
+          "GIN106", ctx.rel, 0, "", f"cannot read config: {e}"))
+      return
+    for stmt, lineno in ginlite.split_statements(text):
+      _validate_statement(ctx, stmt, lineno)
+
+  def _validate_statement(ctx: _FileContext, stmt: str,
+                          lineno: int) -> None:
+    from tensor2robot_tpu.config import ginlite
+
+    if stmt.startswith("import "):
+      module = stmt[len("import "):].strip()
+      try:
+        importlib.import_module(module)
+      except ImportError as e:
+        findings.append(Finding(
+            "GIN106", ctx.rel, lineno, "",
+            f"`import {module}` failed: {e}"))
+      return
+    if stmt.startswith("include "):
+      try:
+        target = ginlite.parse_value(stmt[len("include "):].strip())
+      except ginlite.GinError as e:
+        findings.append(Finding(
+            "GIN107", ctx.rel, lineno, "",
+            f"unparseable include: {e}"))
+        return
+      resolved = ginlite.resolve_config_path(
+          str(target), including_dir=os.path.dirname(ctx.path))
+      if resolved is None:
+        findings.append(Finding(
+            "GIN106", ctx.rel, lineno, "",
+            f"include {target!r} not found on the config search path"))
+        return
+      walk_file(resolved)
+      return
+    m = ginlite._STATEMENT_RE.match(stmt)
+    if not m:
+      findings.append(Finding(
+          "GIN107", ctx.rel, lineno, "",
+          f"cannot parse config statement: {stmt.splitlines()[0]!r}"))
+      return
+    target = m.group("target").strip()
+    try:
+      value = ginlite.parse_value(m.group("value").strip())
+    except ginlite.GinError as e:
+      findings.append(Finding(
+          "GIN107", ctx.rel, lineno, "", f"unparseable value: {e}"))
+      return
+    _collect_value_refs(ctx, lineno, value)
+    scope, _, rest = target.rpartition("/")
+    if "." not in rest:
+      macros_defined.add(target)
+      return
+    name, _, param = rest.rpartition(".")
+    _validate_binding(ctx, lineno, name, param)
+
+  def _collect_value_refs(ctx: _FileContext, lineno: int,
+                          value) -> None:
+    from tensor2robot_tpu.config import ginlite
+
+    if isinstance(value, ginlite._Reference):
+      cfg = _safe_lookup(value.name)
+      if cfg is None:
+        findings.append(Finding(
+            "GIN104", ctx.rel, lineno, "",
+            f"@{value.name} does not resolve to any registered "
+            "configurable"))
+    elif isinstance(value, ginlite._Macro):
+      macro_uses.append((ctx, lineno, value.name))
+    elif isinstance(value, (list, tuple)):
+      for item in value:
+        _collect_value_refs(ctx, lineno, item)
+    elif isinstance(value, dict):
+      for k, v in value.items():
+        _collect_value_refs(ctx, lineno, k)
+        _collect_value_refs(ctx, lineno, v)
+
+  def _validate_binding(ctx: _FileContext, lineno: int, name: str,
+                        param: str) -> None:
+    cfg = _safe_lookup(name)
+    if cfg is None:
+      findings.append(Finding(
+          "GIN101", ctx.rel, lineno, "",
+          f"binding target {name!r} matches no registered "
+          "configurable (typo, missing import line, or missing "
+          "register_lazy_configurables entry)"))
+      return
+    if param in cfg.denylist:
+      findings.append(Finding(
+          "GIN105", ctx.rel, lineno, "",
+          f"{cfg.full_name}.{param} is denylisted and cannot be "
+          "configured"))
+      return
+    params, has_kwargs = accepted_parameters(cfg.fn)
+    if param not in params and not has_kwargs:
+      known = ", ".join(sorted(params)) or "<none>"
+      findings.append(Finding(
+          "GIN102", ctx.rel, lineno, "",
+          f"{cfg.full_name} has no parameter {param!r} "
+          f"(signature accepts: {known})"))
+
+  def _safe_lookup(name: str):
+    from tensor2robot_tpu.config import ginlite
+    try:
+      return ginlite._lookup_configurable(name)
+    except ginlite.GinError as e:  # ambiguous name
+      findings.append(Finding(
+          "GIN101", os.path.basename(path), 0, "", str(e)))
+      return None
+
+  walk_file(path)
+  for ctx, lineno, macro in macro_uses:
+    if macro not in macros_defined:
+      findings.append(Finding(
+          "GIN103", ctx.rel, lineno, "",
+          f"%{macro} is referenced but never defined in this "
+          "config's include closure"))
+  return findings
+
+
+def discover_configs(paths: Sequence[str]) -> List[str]:
+  from tensor2robot_tpu.analysis.astutil import iter_files
+  return list(iter_files(paths, suffix=".gin"))
+
+
+def run_gin_rules(paths: Sequence[str], root: str,
+                  extra_modules: Sequence[str] = ()) -> List[Finding]:
+  """Validates every .gin under `paths` (files or directories)."""
+  findings: List[Finding] = []
+  failed = ensure_registrations(extra_modules)
+  for module in failed:
+    findings.append(Finding(
+        "GIN106", module, 0, "",
+        f"default configurable family {module!r} failed to import; "
+        "configs referencing it will misvalidate"))
+  for config in discover_configs(paths):
+    findings.extend(validate_config_file(config, root))
+  findings.sort(key=lambda f: (f.path, f.line, f.rule))
+  return findings

@@ -1,0 +1,105 @@
+"""Import hygiene for plane-worker-safe modules (rule IMP401).
+
+The host data plane's worker processes (`data/plane._worker_main`)
+import `tensor2robot_tpu.data.plane` + `data.shm_ring` + the config
+engine at spawn. Those workers only parse and memcpy; a module-level
+`import jax` anywhere in that closure costs seconds of spin-up PER
+WORKER and drags a full XLA runtime into processes that never touch a
+device — exactly why `data/__init__` went lazy (PEP 562) in the first
+place. This rule pins that property statically: the declared
+worker-safe set must not reach `jax` (or `tensorflow`) through any
+chain of module-level project imports.
+
+The check is transitive over PROJECT modules only (external packages
+other than the banned ones are opaque), and it reports the full import
+chain so the fix is obvious.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from tensor2robot_tpu.analysis.astutil import parse_module
+from tensor2robot_tpu.analysis.findings import Finding
+
+# Modules that must stay importable without jax/tensorflow. Spawn-path
+# closure of the data-plane worker: the plane module itself, the ring,
+# and the config engine the plane imports for @gin.configurable.
+WORKER_SAFE_MODULES = (
+    "tensor2robot_tpu.data.plane",
+    "tensor2robot_tpu.data.shm_ring",
+    "tensor2robot_tpu.config",
+    "tensor2robot_tpu.config.ginlite",
+)
+
+BANNED_IMPORTS = ("jax", "tensorflow")
+
+
+def _module_file(dotted: str, root: str) -> Optional[str]:
+  rel = dotted.replace(".", os.sep)
+  for candidate in (os.path.join(root, rel + ".py"),
+                    os.path.join(root, rel, "__init__.py")):
+    if os.path.exists(candidate):
+      return candidate
+  return None
+
+
+def _module_level_imports(dotted: str, root: str,
+                          cache: Dict[str, List[str]]) -> List[str]:
+  if dotted in cache:
+    return cache[dotted]
+  cache[dotted] = []  # break recursion cycles
+  path = _module_file(dotted, root)
+  if path is None:
+    return cache[dotted]
+  module = parse_module(path, root)
+  if module is None:
+    return cache[dotted]
+  cache[dotted] = list(dict.fromkeys(module.module_imports))
+  return cache[dotted]
+
+
+def _find_banned_chain(start: str, root: str,
+                       cache: Dict[str, List[str]]
+                       ) -> Optional[Tuple[List[str], str]]:
+  """BFS over project-internal module-level imports; returns the
+  (chain, banned_module) of the first banned reach, else None."""
+  seen = {start}
+  frontier: List[Tuple[str, List[str]]] = [(start, [start])]
+  while frontier:
+    current, chain = frontier.pop(0)
+    for imported in _module_level_imports(current, root, cache):
+      head = imported.split(".")[0]
+      if head in BANNED_IMPORTS:
+        return chain, imported
+      if head != start.split(".")[0]:
+        continue  # external (non-project) module: opaque
+      # A parent-package import (`from tensor2robot_tpu import config`)
+      # executes the package __init__ — follow both forms.
+      for target in (imported,):
+        if target not in seen and _module_file(target, root):
+          seen.add(target)
+          frontier.append((target, chain + [target]))
+  return None
+
+
+def run_import_rules(root: str,
+                     worker_safe: Sequence[str] = WORKER_SAFE_MODULES
+                     ) -> List[Finding]:
+  findings: List[Finding] = []
+  cache: Dict[str, List[str]] = {}
+  for dotted in worker_safe:
+    result = _find_banned_chain(dotted, root, cache)
+    if result is None:
+      continue
+    chain, banned = result
+    path = _module_file(chain[-1], root)
+    rel = os.path.relpath(path, root).replace(os.sep, "/") if path \
+        else chain[-1]
+    findings.append(Finding(
+        "IMP401", rel, 0, "",
+        f"worker-safe module {dotted} reaches `{banned}` at import "
+        "time via " + " -> ".join(chain)
+        + " — plane workers would pay that import per spawn"))
+  return findings

@@ -1,0 +1,295 @@
+"""JAX tracing-hazard linter (rules JAX201–JAX204). Pure AST — no jax.
+
+The Podracer/pjit lesson (PAPERS.md, arXiv:2104.06272 / 2204.06514):
+host-side Python hazards inside traced code — accidental device syncs,
+impure host calls, Python control flow on tracer values — silently
+destroy accelerator utilization or break retrace caching, and nothing
+crashes. This linter walks the set of functions REACHABLE UNDER A
+TRACE and flags the hazard classes statically.
+
+Traced-entry detection (per module):
+  * decorators: ``@jax.jit``, ``@jit``, ``@pjit``,
+    ``@functools.partial(jax.jit, ...)``, ``@checkpoint``/``remat``;
+  * call sites: a function NAME (or ``self.method``/lambda) passed as
+    the first argument to ``jax.jit`` / ``pjit`` / ``jax.grad`` /
+    ``value_and_grad`` / ``vmap`` / ``pmap`` / ``shard_map`` /
+    ``jax.lax.scan`` / ``while_loop`` / ``fori_loop`` / ``cond`` /
+    ``jax.checkpoint``;
+  * reachability: from every entry, calls are followed to functions in
+    the same module (bare name), methods of the same class
+    (``self.x(...)``), and module-qualified project functions
+    (``alias.fn(...)`` where the alias maps into the analyzed tree).
+
+Dynamic dispatch (a function object arriving through a parameter) is
+not followed — the linter under-approximates reachability rather than
+drowning the repo in speculative findings. docs/ANALYSIS.md states the
+contract.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tensor2robot_tpu.analysis.astutil import (
+    Module,
+    call_name,
+    dotted_name,
+    modules_by_dotted_path,
+    parse_tree,
+    resolve_callee,
+)
+from tensor2robot_tpu.analysis.findings import Finding
+
+# Callables whose FIRST argument becomes traced code.
+_TRACING_WRAPPERS = {
+    "jax.jit", "jit", "pjit", "jax.pjit",
+    "jax.grad", "grad", "jax.value_and_grad", "value_and_grad",
+    "jax.vmap", "vmap", "jax.pmap", "pmap",
+    "jax.checkpoint", "jax.remat", "checkpoint", "remat",
+    "shard_map", "jax.experimental.shard_map.shard_map",
+    "jax.experimental.shard_map", "shard_map_compat",
+    "jax.lax.scan", "lax.scan",
+    "jax.lax.while_loop", "lax.while_loop",
+    "jax.lax.fori_loop", "lax.fori_loop",
+    "jax.lax.cond", "lax.cond", "jax.lax.map", "lax.map",
+}
+
+# Decorator spellings that make the decorated function traced.
+_TRACING_DECORATORS = {
+    "jax.jit", "jit", "pjit", "jax.pjit", "jax.checkpoint",
+    "jax.remat", "checkpoint", "remat", "partial", "functools.partial",
+}
+
+# JAX201 — host syncs.
+_SYNC_CALLS = {
+    "jax.block_until_ready", "block_until_ready", "jax.device_get",
+    "device_get",
+}
+_SYNC_METHOD_SUFFIXES = (".block_until_ready", ".item")
+
+# JAX202 — impure host calls.
+_IMPURE_EXACT = {
+    "print", "open", "input",
+    "time.time", "time.perf_counter", "time.monotonic",
+    "time.process_time", "time.sleep", "time.time_ns",
+}
+_IMPURE_PREFIXES = ("numpy.random.", "random.", "np.random.",
+                    "os.environ", "subprocess.")
+
+
+def _first_call_arg(call: ast.Call) -> Optional[ast.AST]:
+  if call.args:
+    return call.args[0]
+  for kw in call.keywords:  # jax.lax.scan(f=..., ...)
+    if kw.arg in ("f", "fun", "body", "body_fun", "cond_fun"):
+      return kw.value
+  return None
+
+
+class _TracedSet:
+  """(module index, qualname) pairs known to run under a trace."""
+
+  def __init__(self, modules: Sequence[Module]):
+    self.modules = list(modules)
+    # module rel path -> Module (and dotted project path -> Module).
+    self.by_rel: Dict[str, Module] = {m.rel: m for m in self.modules}
+    self.by_dotted: Dict[str, Module] = modules_by_dotted_path(
+        self.modules)
+    # traced (module, qualname) -> entry reason; entries marks direct.
+    self.traced: Dict[Tuple[int, str], bool] = {}
+    self.lambda_entries: List[Tuple[Module, ast.Lambda]] = []
+
+  def mark(self, module: Module, qualname: str, direct: bool) -> bool:
+    key = (id(module), qualname)
+    if key in self.traced:
+      if direct and not self.traced[key]:
+        self.traced[key] = True
+        return True
+      return False
+    self.traced[key] = direct
+    return True
+
+  def is_traced(self, module: Module, qualname: str) -> bool:
+    return (id(module), qualname) in self.traced
+
+  def is_direct(self, module: Module, qualname: str) -> bool:
+    return self.traced.get((id(module), qualname), False)
+
+
+def _find_entries(ts: _TracedSet) -> None:
+  for module in ts.modules:
+    # Decorated functions.
+    for qual, info in module.functions.items():
+      for dec in info.node.decorator_list:
+        dec_name = module.expand(dotted_name(dec))
+        if dec_name in _TRACING_DECORATORS and not isinstance(
+            dec, ast.Call):
+          if dec_name in ("partial", "functools.partial"):
+            continue  # bare @partial decorates nothing traced
+          ts.mark(module, qual, direct=True)
+        elif isinstance(dec, ast.Call):
+          callee = module.expand(dotted_name(dec.func))
+          if callee in ("partial", "functools.partial"):
+            inner = dec.args and module.expand(
+                dotted_name(dec.args[0]))
+            if inner in _TRACING_WRAPPERS:
+              ts.mark(module, qual, direct=True)
+          elif callee in _TRACING_WRAPPERS:
+            ts.mark(module, qual, direct=True)
+    # Call sites handing a local function to a tracing wrapper.
+    for node in ast.walk(module.tree):
+      if not isinstance(node, ast.Call):
+        continue
+      callee = module.expand(call_name(node))
+      if callee not in _TRACING_WRAPPERS:
+        continue
+      arg = _first_call_arg(node)
+      if arg is None:
+        continue
+      if isinstance(arg, ast.Lambda):
+        ts.lambda_entries.append((module, arg))
+        continue
+      target_name = dotted_name(arg)
+      if not target_name:
+        continue
+      enclosing = module.enclosing_function(node)
+      if "." not in target_name:
+        if target_name in module.functions:
+          ts.mark(module, target_name, direct=True)
+      elif target_name.startswith("self.") and enclosing \
+          and enclosing.class_name:
+        qual = f"{enclosing.class_name}.{target_name[5:]}"
+        if qual in module.functions:
+          ts.mark(module, qual, direct=True)
+
+
+def _propagate(ts: _TracedSet) -> None:
+  """Closes the traced set over statically-resolvable calls."""
+  changed = True
+  while changed:
+    changed = False
+    for module in ts.modules:
+      for qual, info in module.functions.items():
+        if not ts.is_traced(module, qual):
+          continue
+        for node in ast.walk(info.node):
+          if not isinstance(node, ast.Call):
+            continue
+          resolved = resolve_callee(ts.by_dotted, module, info, node)
+          if resolved is None:
+            continue
+          callee_mod, callee_qual = resolved
+          if not ts.is_traced(callee_mod, callee_qual):
+            ts.mark(callee_mod, callee_qual, direct=False)
+            changed = True
+
+
+def _scan_traced_body(module: Module, scope: str, body: ast.AST,
+                      params: Sequence[str], direct_entry: bool,
+                      findings: List[Finding]) -> None:
+  param_set = set(params)
+  for node in ast.walk(body):
+    if isinstance(node, ast.Call):
+      name = call_name(node)
+      expanded = module.expand(name)
+      if name and (name in _SYNC_CALLS or expanded in _SYNC_CALLS
+                   or name.endswith(_SYNC_METHOD_SUFFIXES)):
+        findings.append(Finding(
+            "JAX201", module.rel, node.lineno, scope,
+            f"host sync `{name}(...)` inside traced code forces a "
+            "device round-trip per step"))
+      elif name in ("float", "int", "bool") and node.args \
+          and isinstance(node.args[0], ast.Name) \
+          and node.args[0].id in param_set:
+        findings.append(Finding(
+            "JAX201", module.rel, node.lineno, scope,
+            f"`{name}({node.args[0].id})` on a traced argument "
+            "materializes it on host (sync) or fails to trace"))
+      elif expanded and (
+          expanded in _IMPURE_EXACT
+          or any(expanded.startswith(p) for p in _IMPURE_PREFIXES)):
+        findings.append(Finding(
+            "JAX202", module.rel, node.lineno, scope,
+            f"impure call `{expanded}(...)` inside traced code runs "
+            "once at trace time, not per step"))
+      elif name in _IMPURE_EXACT:
+        findings.append(Finding(
+            "JAX202", module.rel, node.lineno, scope,
+            f"impure call `{name}(...)` inside traced code runs once "
+            "at trace time, not per step"))
+    elif isinstance(node, ast.Global):
+      findings.append(Finding(
+          "JAX204", module.rel, node.lineno, scope,
+          f"`global {', '.join(node.names)}` inside traced code: "
+          "mutation happens at trace time only and breaks retrace "
+          "caching"))
+    elif isinstance(node, (ast.If, ast.While)) and direct_entry:
+      hit = _tracer_branch_param(node.test, param_set)
+      if hit and not _is_guard_body(node):
+        kind = "if" if isinstance(node, ast.If) else "while"
+        findings.append(Finding(
+            "JAX203", module.rel, node.lineno, scope,
+            f"Python `{kind}` on traced argument `{hit}` — branches "
+            "on tracer values fail or silently bake in one path; use "
+            "jax.lax.cond/while_loop or a static arg"))
+
+
+def _tracer_branch_param(test: ast.AST, params: Set[str]
+                         ) -> Optional[str]:
+  """First traced param a branch test depends on.
+
+  Trace-time-static idioms are excluded by design (documented in
+  docs/ANALYSIS.md): `is`/`is not` comparisons (None-checks on
+  optional args), `isinstance`/`len`/`hasattr` tests, and BARE-NAME
+  truthiness (`if batch_stats:`) — in this codebase that idiom tests
+  container emptiness of a pytree, which is static under trace, while
+  the genuine tracer-branch bug class shows up as comparisons or
+  arithmetic on the argument (`if loss > 0:`)."""
+  if isinstance(test, ast.Compare) and all(
+      isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+    return None
+  if isinstance(test, ast.Name):
+    return None
+  for node in ast.walk(test):
+    if isinstance(node, ast.Call):
+      name = call_name(node)
+      if name in ("isinstance", "callable", "len", "hasattr",
+                  "getattr"):
+        return None
+    if isinstance(node, ast.Name) and node.id in params:
+      return node.id
+  return None
+
+
+def _is_guard_body(node: ast.AST) -> bool:
+  """True for `if <cond>: raise ...` shape/validation guards — those
+  run (and fail loudly) at trace time, the behavior the author wants,
+  and their condition is almost always a static shape/hyperparameter
+  check."""
+  body = getattr(node, "body", [])
+  return bool(body) and all(
+      isinstance(stmt, (ast.Raise, ast.Assert)) for stmt in body) \
+      and not getattr(node, "orelse", [])
+
+
+def run_jax_rules(paths: Sequence[str], root: str) -> List[Finding]:
+  modules = parse_tree(paths, root)
+  ts = _TracedSet(modules)
+  _find_entries(ts)
+  _propagate(ts)
+  findings: List[Finding] = []
+  for module in ts.modules:
+    for qual, info in module.functions.items():
+      if not ts.is_traced(module, qual):
+        continue
+      _scan_traced_body(module, qual, info.node, info.params,
+                        ts.is_direct(module, qual), findings)
+  for module, lam in ts.lambda_entries:
+    scope = (module.enclosing_function(lam) or lam)
+    scope_name = getattr(scope, "qualname", "<module>")
+    params = [a.arg for a in lam.args.args]
+    _scan_traced_body(module, f"{scope_name}.<lambda>", lam.body,
+                      params, True, findings)
+  findings.sort(key=lambda f: (f.path, f.line, f.rule))
+  return findings

@@ -14,6 +14,7 @@ Usage:
 from __future__ import annotations
 
 import importlib
+import os
 
 from absl import app
 from absl import flags
@@ -30,6 +31,11 @@ flags.DEFINE_multi_string(
 flags.DEFINE_multi_string(
     "import_modules", [],
     "Extra modules to import before parsing (to register configurables).")
+flags.DEFINE_bool(
+    "validate_only", False,
+    "Statically validate --gin_configs (t2rcheck gin rules: unknown "
+    "configurables/params, dangling macros/refs, bad includes) and "
+    "exit non-zero on findings instead of training.")
 flags.DEFINE_string(
     "jax_coordinator_address", None,
     "host:port of process 0 for multi-host training "
@@ -63,6 +69,28 @@ _DEFAULT_MODULES = (
 
 def main(argv):
   del argv
+  configs = [c for entry in FLAGS.gin_configs for c in entry.split(",")]
+  if FLAGS.validate_only:
+    # Fleet pre-flight: catch a typo'd binding in seconds instead of
+    # minutes into a training run (docs/ANALYSIS.md). Runs BEFORE the
+    # multi-host wiring — validation needs registrations, not devices,
+    # and a lone pre-flight process must never block inside
+    # jax.distributed.initialize waiting for peers that aren't there.
+    import sys
+
+    from tensor2robot_tpu.analysis import gin_check
+
+    _import_configurable_families()
+    findings = []
+    for config in configs:
+      resolved = gin.resolve_config_path(config) or config
+      findings.extend(gin_check.validate_config_file(
+          resolved, os.getcwd()))
+    for finding in findings:
+      print(finding.render())
+    print(f"validate_only: {len(findings)} finding(s) in "
+          f"{len(configs)} config(s)")
+    sys.exit(1 if findings else 0)
   # Multi-host wiring comes first: jax.distributed must initialize
   # before any device use (SURVEY §3 "multi-slice via jax distributed
   # init"). Single-process runs no-op.
@@ -73,6 +101,12 @@ def main(argv):
       process_id=FLAGS.jax_process_id,
       force=FLAGS.jax_init_distributed,
   )
+  _import_configurable_families()
+  gin.parse_config_files_and_bindings(configs, FLAGS.gin_bindings)
+  train_eval.train_eval_model()
+
+
+def _import_configurable_families() -> None:
   for module in list(_DEFAULT_MODULES) + list(FLAGS.import_modules):
     try:
       importlib.import_module(module)
@@ -81,9 +115,6 @@ def main(argv):
         raise
       # In-tree families are best-effort (optional deps may be absent).
       print(f"Note: skipping {module}: {e}")
-  configs = [c for entry in FLAGS.gin_configs for c in entry.split(",")]
-  gin.parse_config_files_and_bindings(configs, FLAGS.gin_bindings)
-  train_eval.train_eval_model()
 
 
 if __name__ == "__main__":

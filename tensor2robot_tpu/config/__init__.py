@@ -21,4 +21,6 @@ from tensor2robot_tpu.config.ginlite import (
     parse_value,
     query_parameter,
     register_lazy_configurables,
+    resolve_config_path,
+    split_statements,
 )

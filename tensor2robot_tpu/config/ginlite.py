@@ -511,27 +511,38 @@ _STATEMENT_RE = re.compile(
     r"^(?P<target>[\w./]+(?:\.[\w]+)?)\s*=\s*(?P<value>.+)$", re.DOTALL)
 
 
-def parse_config(config: str, skip_unknown: bool = False) -> None:
-  """Parses gin-format config text into the global registry."""
+def split_statements(config: str) -> List[Tuple[str, int]]:
+  """Gin text → [(statement, first line number)] (comments stripped).
+
+  Continuation joining: a statement continues while brackets are open
+  or the line ends with an operator. Public so the static validator
+  (`analysis/gin_check.py`) can walk statements with real line numbers
+  without executing them.
+  """
   lines = config.split("\n")
-  # Join continuation lines: a statement continues while brackets are open
-  # or the line ends with an operator.
-  statements: List[str] = []
+  statements: List[Tuple[str, int]] = []
   buf = ""
   depth = 0
-  for raw in lines:
+  start = 0
+  for lineno, raw in enumerate(lines, start=1):
     line = raw.split("#", 1)[0].rstrip()
     if not line.strip() and depth == 0:
       continue
+    if not buf:
+      start = lineno
     buf = (buf + "\n" + line) if buf else line
     depth = _bracket_depth(buf)
     if depth == 0 and not buf.rstrip().endswith((",", "=", "\\")):
-      statements.append(buf.strip())
+      statements.append((buf.strip(), start))
       buf = ""
   if buf.strip():
-    statements.append(buf.strip())
+    statements.append((buf.strip(), start))
+  return statements
 
-  for stmt in statements:
+
+def parse_config(config: str, skip_unknown: bool = False) -> None:
+  """Parses gin-format config text into the global registry."""
+  for stmt, _ in split_statements(config):
     _parse_statement(stmt, skip_unknown=skip_unknown)
 
 
@@ -606,24 +617,39 @@ def add_config_file_search_path(path: str) -> None:
   _SEARCH_PATHS.append(path)
 
 
-def parse_config_file(path: str, skip_unknown: bool = False) -> None:
+def resolve_config_path(path: str,
+                        including_dir: Optional[str] = None
+                        ) -> Optional[str]:
+  """Resolves a config path through the documented search order.
+
+  `including_dir` substitutes for the live include stack — the static
+  validator resolves includes without parsing into the registry.
+  """
   bases = list(_SEARCH_PATHS)
-  if _INCLUDE_DIR_STACK:
+  if including_dir is not None:
+    bases.append(including_dir)
+  elif _INCLUDE_DIR_STACK:
     bases.append(_INCLUDE_DIR_STACK[-1])
   bases.append(_PACKAGE_ROOT)
   for base in bases:
     candidate = os.path.join(base, path) if base else path
     if os.path.exists(candidate):
-      _INCLUDE_DIR_STACK.append(os.path.dirname(os.path.abspath(
-          candidate)))
-      try:
-        with open(candidate) as f:
-          parse_config(f.read(), skip_unknown=skip_unknown)
-      finally:
-        _INCLUDE_DIR_STACK.pop()
-      return
-  raise GinError(f"Config file not found: {path!r} "
-                 f"(search paths: {bases})")
+      return candidate
+  return None
+
+
+def parse_config_file(path: str, skip_unknown: bool = False) -> None:
+  candidate = resolve_config_path(path)
+  if candidate is None:
+    raise GinError(f"Config file not found: {path!r} "
+                   f"(search paths: {list(_SEARCH_PATHS)} + include "
+                   f"dir + package root)")
+  _INCLUDE_DIR_STACK.append(os.path.dirname(os.path.abspath(candidate)))
+  try:
+    with open(candidate) as f:
+      parse_config(f.read(), skip_unknown=skip_unknown)
+  finally:
+    _INCLUDE_DIR_STACK.pop()
 
 
 def parse_config_files_and_bindings(

@@ -314,9 +314,19 @@ class ShardedPrefetcher:
     return self
 
   def __next__(self):
-    if self._stop.is_set():
-      raise StopIteration
-    item = self._queue.get()
+    # Timed-slice get: a bare `get()` would strand this consumer
+    # forever if `close()` ran between the empty-queue check and the
+    # block — close() drains the queue and the worker's bounded
+    # sentinel-put gives up once `_stop` is set, so nothing would ever
+    # arrive to wake a blocked consumer (found by t2rcheck CON302).
+    while True:
+      if self._stop.is_set():
+        raise StopIteration
+      try:
+        item = self._queue.get(timeout=0.1)
+        break
+      except queue.Empty:
+        continue
     if item is self._done:
       if self._error is not None:
         raise self._error

@@ -63,6 +63,7 @@ def maybe_initialize_distributed(
 
   import jax
 
+  _maybe_enable_cpu_collectives()
   kwargs = {}
   if coordinator_address:
     kwargs["coordinator_address"] = coordinator_address
@@ -77,3 +78,32 @@ def maybe_initialize_distributed(
       "devices.", jax.process_index(), jax.process_count(),
       jax.local_device_count(), jax.device_count())
   return True
+
+
+def _maybe_enable_cpu_collectives() -> None:
+  """Selects the gloo CPU collectives backend for multi-process CPU.
+
+  XLA:CPU's default collectives cannot span processes at all
+  ("Multiprocess computations aren't implemented on the CPU backend")
+  — every off-accelerator multi-process run (CI, the two-process
+  distributed test, a laptop fleet rehearsal) needs jax's gloo-based
+  cross-process CPU collectives, selected via
+  `jax_cpu_collectives_implementation` BEFORE
+  `jax.distributed.initialize`. The option only governs the CPU
+  backend's cross-process collectives, so it is selected whenever the
+  CPU backend could end up primary: platforms unset (auto-detect on a
+  CPU-only host) or explicitly naming cpu. Only an explicit
+  accelerator-only selection (e.g. `JAX_PLATFORMS=tpu`) skips it; on
+  jax builds without the option this degrades to the old behavior.
+  """
+  import jax
+
+  platforms = (os.environ.get("JAX_PLATFORMS", "")
+               or str(getattr(jax.config, "jax_platforms", None) or ""))
+  if platforms and "cpu" not in platforms.lower():
+    return  # accelerator-only selection: CPU backend never primary
+  try:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+  except Exception:  # older/newer jax: option renamed or absent
+    log.warning("could not select gloo CPU collectives; multi-process "
+                "CPU runs may fail", exc_info=True)

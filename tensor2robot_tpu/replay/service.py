@@ -212,7 +212,7 @@ class ReplayWriteService:
     item = _Enqueued(flat, n, priority)
     try:
       if self._overflow == "block":
-        self._queue.put(item, timeout=self._block_timeout)
+        self._put_blocking(item)
       else:
         self._queue.put_nowait(item)
     except queue.Full:
@@ -224,6 +224,33 @@ class ReplayWriteService:
     with self._lock:
       self.enqueued_batches += 1
     return True
+
+  def _put_blocking(self, item: _Enqueued) -> None:
+    """Backpressure put that still notices a dead writer.
+
+    A bare ``put(timeout=None)`` would strand the producer FOREVER if
+    the writer thread died while the queue was full — the error latch
+    is only checked on `_enqueue` entry, and a dead writer never
+    drains (found by t2rcheck CON302 triage). Wait in short slices,
+    re-checking the latch each slice; `block_timeout_secs` still caps
+    the total wait (queue.Full on expiry → counted drop, unchanged).
+    """
+    deadline = (time.monotonic() + self._block_timeout
+                if self._block_timeout is not None else None)
+    while True:
+      if self._error is not None:
+        raise RuntimeError("replay writer thread died") from self._error
+      slice_secs = 0.05
+      if deadline is not None:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+          raise queue.Full
+        slice_secs = min(slice_secs, remaining)
+      try:
+        self._queue.put(item, timeout=slice_secs)
+        return
+      except queue.Full:
+        continue
 
   def _count_abort(self, actor_id: str) -> None:
     with self._lock:

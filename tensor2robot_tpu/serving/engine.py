@@ -140,6 +140,10 @@ class BucketedServingEngine:
         # the advisory warning would spam every warmup.
         warnings.filterwarnings(
             "ignore", message=".*donated buffers were not usable.*")
+        # Compiling under the lock is the POINT of this lock: it
+        # serializes an async warmup against a cold predict so the
+        # same bucket never compiles twice; only compilers contend.
+        # t2rcheck: disable=CON301
         self._compiled[bucket] = self._jitted.lower(*args).compile()
       _COMPILE_COUNT += 1
 
@@ -205,9 +209,15 @@ class BucketedServingEngine:
     current reference once per dispatch.
     """
     with self._swap_lock:
+      # Holding the lock across the transfer is intentional: only
+      # SWAPPERS contend here (the hot path reads `self._state`
+      # lock-free), and overlapping transfers of two checkpoint trees
+      # would waste device memory for no ordering benefit.
+      # t2rcheck: disable=CON301
       placed = jax.device_put(new_state)
       # Block BEFORE publishing: a dispatch must never race ahead of
       # a half-transferred restore.
+      # t2rcheck: disable=CON301
       jax.block_until_ready(placed)
       self._state = placed
       self.swap_count += 1

@@ -157,7 +157,16 @@ class TestTrainStepCollectives:
     counts = compile_qtopt_step(((DATA_AXIS, 4), (FSDP_AXIS, 2)),
                                 "replicated")
     assert counts["all-reduce"] >= 1, counts
-    assert counts["all-gather"] <= 2, counts
+    # Re-pin (jax 0.4.37): the replicated baseline's absolute
+    # all-gather count is partitioner-CHOSEN input-reshard traffic
+    # (measured 1 on the round-4 toolchain, 5 here — the combiner now
+    # splits reshards it used to fuse), and this file's own philosophy
+    # says chosen counts get bounds or differences, never absolutes.
+    # The `<= 2` pin was a disguised absolute; the contract that
+    # matters — fsdp param gathers exist ON TOP of whatever reshard
+    # gathers the baseline has — is the difference below (measured
+    # 10 vs 5).
+    assert counts["all-gather"] < fsdp["all-gather"], (fsdp, counts)
     # The zero-style param gathers are the DIFFERENCE between the two
     # layouts, whatever the combiner does within each.
     assert fsdp["all-gather"] - counts["all-gather"] >= 3, (
