@@ -76,6 +76,15 @@ Usage: python bench.py [--paper] [--profile DIR] [--input] [--replay]
   --mxu      measure the 128-wide (MXU-filling) PRIMARY variant and
              record the committed flagship-width decision (steps/s is
              the target metric; the 64-wide step is HBM-bound).
+  --mfu      the MFU-lever axis (mfu_levers section): steps/s + MFU
+             per ISSUE-7 lever — bf16 vs int8 CEM inference tower ×
+             lax vs fused (Pallas running-top-k) select, and the
+             remat-policy sweep — all denominated in the shared
+             analytic model-flops helper so the levers are comparable
+             (XLA's count of a levered program moves; the model's
+             doesn't). With --dry-run: tiny model, 2-step scans,
+             analytic-vs-XLA flops cross-check, no BENCH_DETAIL.json
+             write — the tier-1 smoke.
   --coldstart  the restart-latency axis (coldstart section): trainer
              time-to-first-step and serving time-to-first-prediction,
              each measured COLD-cache vs WARM-cache in fresh
@@ -114,7 +123,124 @@ SCAN_STEPS = 200
 TRIALS = 6
 
 
-def build(paper, width: int = 64):
+def _same_conv_taps(h: int, k: int, s: int):
+  """(out_size, valid_taps) of one spatial dim of a SAME conv.
+
+  XLA cost analysis counts only VALID multiply-adds — border output
+  positions whose window overlaps SAME padding contribute fewer taps
+  (probed: a lone 8×8 stride-2 3×3 conv costs 11²/12² of the naive
+  k² count). Mirroring that here keeps analytic/XLA ratios ≈ 1.
+  """
+  pad_total = max(k - (s if h % s == 0 else h % s), 0)
+  pad_low = pad_total // 2
+  out = -(-h // s)
+  taps = sum(min(i * s - pad_low + k, h) - max(i * s - pad_low, 0)
+             for i in range(out))
+  return out, taps
+
+
+def analytic_flops(kind: str, **kw):
+  """THE shared analytic-FLOPs model for every MFU figure in this file.
+
+  MFU's denominator is MODEL flops from shapes — NOT XLA's count of
+  the compiled program — so the figure stays comparable across
+  dtype/remat/kernel levers: an int8 tower or a remat recompute does
+  not change the model, only the schedule, and must not move the
+  denominator (docs/PERF.md). XLA cost analysis rides along in the
+  detail sections as a cross-check (`xla_flops_per_step`, ratio
+  asserted near 1 on the unlevered program).
+
+  kinds:
+    "qtopt_step": one fused Bellman step — kw: learner, batch_size.
+      CEM target (encode once + I scored populations through the
+      linearity-split head) + critic fwd/bwd (bwd = 2× fwd) + the
+      elementwise optimizer/Polyak tail.
+    "attention": flash attention forward — kw: b, heads, d, t,
+      causal. (The long-context axis's 4·B·H·D·T² [/2 causal].)
+  """
+  if kind == "attention":
+    flops = 4 * kw["b"] * kw["heads"] * kw["d"] * kw["t"] * kw["t"]
+    return flops / 2 if kw.get("causal", True) else flops
+
+  if kind != "qtopt_step":
+    raise ValueError(f"unknown analytic_flops kind {kind!r}")
+  learner = kw["learner"]
+  batch = kw["batch_size"]
+  model = learner.model
+  net = model.network
+  s2d = net.space_to_depth
+  h = model.image_size // max(s2d, 1)
+  cin = 3 * max(s2d, 1) ** 2
+
+  def conv_flops(n, h_in, k, s, ci, co):
+    out, taps = _same_conv_taps(h_in, k, s)
+    return out, 2 * n * taps * taps * ci * co
+
+  def seq_convs(n, h_in, ci, filters, first_stride):
+    """Conv stack flops + BN/relu elementwise; returns (flops, h, c)."""
+    total = 0.0
+    for i, co in enumerate(filters):
+      s = first_stride if i == 0 else 2
+      h_in, f = conv_flops(n, h_in, 3, s, ci, co)
+      total += f + 3 * n * h_in * h_in * co  # BN affine + relu
+      ci = co
+    return total, h_in, ci
+
+  torso_first_stride = 1 if s2d > 1 else 2
+  encode_n1, he, ce = seq_convs(1, h, cin, net.torso_filters,
+                                torso_first_stride)
+
+  from tensor2robot_tpu.data.abstract_input_generator import Mode
+  extras_dim = sum(
+      int(np.prod(spec.shape))
+      for key, spec in model.get_feature_specification(
+          Mode.TRAIN).to_flat_dict().items()
+      if key not in ("image", "action"))
+  emb_in = model.action_dim + extras_dim
+  emb = net.action_embedding_size
+  merge_c = net.torso_filters[-1] if net.torso_filters else 3
+  embed_row = 2 * (emb_in * emb + emb * merge_c)
+
+  qhead_dims = [net.head_filters[-1] if net.head_filters else merge_c]
+  qhead_dims += list(net.dense_sizes) + [1]
+  qhead_row = 2 * sum(a * b for a, b in zip(qhead_dims[:-1],
+                                            qhead_dims[1:]))
+
+  p = learner.cem_population
+  iters = learner.cem_iterations
+  rows = batch * p
+  per_iter = rows * (embed_row + qhead_row)
+  if net.head_filters:
+    h2, conv0_row = conv_flops(1, he, 3, 2, ce, net.head_filters[0])
+    c1 = net.head_filters[0]
+    # The linearity split: per-sample action contribution is a GEMM
+    # against the [C, h2·w2·C'] tap-sum tensor, then merge + tail.
+    per_iter += rows * 2 * ce * h2 * h2 * c1        # act GEMM
+    per_iter += rows * 2 * h2 * h2 * c1             # merge add + relu
+    tail, ht, ct = seq_convs(rows, h2, c1, net.head_filters[1:], 2)
+    per_iter += tail + rows * ht * ht * ct          # + mean pool
+    base = (batch * encode_n1
+            + batch * conv0_row                      # enc0, CSE'd
+            + ce * conv0_row)                        # basis tap-sums
+  else:
+    per_iter += rows * he * he * ce                  # pool fallback
+    base = batch * encode_n1
+  cem = base + iters * per_iter
+
+  # Critic fwd: full encode + head at batch rows; bwd = 2× fwd.
+  head_f, hh, hc = ((seq_convs(1, he, ce, net.head_filters, 2))
+                    if net.head_filters else (0.0, he, ce))
+  critic_fwd = batch * (encode_n1 + head_f + hh * hh * hc
+                        + embed_row + qhead_row)
+  # Optimizer/Polyak/grad-norm elementwise tail over the param count.
+  n_params = sum(
+      int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(
+          kw["params"])) if "params" in kw else 0
+  return cem + 3 * critic_fwd + 14 * n_params
+
+
+def build(paper, width: int = 64, cem_inference: str = "int8",
+          cem_select: str = "lax"):
   """(model, learner, batch_size, config description).
 
   `width`: conv/dense channel count. 64 matches the paper's reported
@@ -122,6 +248,15 @@ def build(paper, width: int = 64):
   contracts 128 lanes, so 64-channel convs leave half the array idle
   (measured: 128-wide runs 2.7× the FLOPs at the same step rate at
   paper scale). Applies to both the primary and paper configs.
+
+  `cem_inference`/`cem_select`: the ISSUE-7 MFU levers
+  (docs/PERF.md). The flagship default is the int8 CEM tower — the
+  profiled Bellman step is HBM-bound on the merged population tensor
+  and int8 halves that traffic; parity vs bf16 is gated by
+  tests/test_mfu_levers.py and both variants are measured side by
+  side on the `--mfu` axis. The fused select kernel defaults OFF
+  pending its first on-chip measurement (same burden of proof
+  `ops/cem_head.py` failed — negative results are results).
   """
   from tensor2robot_tpu.research.qtopt import (
       GraspingQModel,
@@ -155,8 +290,16 @@ def build(paper, width: int = 64):
     model = GraspingQModel()  # 64x64 uint8, 4-dim actions, bf16
     batch_size = 256
     desc = "batch=256, 64x64 uint8, CEM 2x64, bf16"
+  if cem_inference != "bf16" or cem_select != "lax":
+    levers = []
+    if cem_inference != "bf16":
+      levers.append(f"{cem_inference} CEM tower")
+    if cem_select != "lax":
+      levers.append("fused select")
+    desc += ", " + " + ".join(levers)
   learner = QTOptLearner(model, cem_iterations=2, cem_population=64,
-                         cem_elites=6)
+                         cem_elites=6, cem_inference=cem_inference,
+                         cem_select=cem_select)
   return model, learner, batch_size, desc
 
 
@@ -173,6 +316,11 @@ def _scan_step_rate(learner, transitions, scan: int, trials: int,
   """
   if state is None:
     state = learner.create_state(jax.random.PRNGKey(0))
+  if getattr(learner, "needs_calibration", False):
+    # int8 CEM tower: activation scales are trace-time constants,
+    # calibrated here on the bench batch (a real replay batch in
+    # training — train_qtopt does the same before its jit).
+    learner.calibrate(state, transitions)
 
   def k_steps(state, transitions, rng):
     def body(carry, i):
@@ -209,11 +357,20 @@ def bench_config(paper: bool, profile_dir=None, width: int = 64):
       learner.transition_specification(), batch_size=batch_size, seed=0)
   transitions = jax.device_put(
       jax.tree_util.tree_map(np.asarray, transitions))
+  if getattr(learner, "needs_calibration", False):
+    learner.calibrate(state, transitions)
 
-  # FLOPs from a single-step compile: no outer scan, CEM unrolled, so
-  # nothing hides inside a once-counted while body.
+  # MFU denominator: the shared analytic MODEL-flops helper — stable
+  # across dtype/remat/kernel levers by construction. XLA's count of
+  # a compiled SINGLE step (no outer scan, CEM unrolled, so nothing
+  # hides inside a once-counted while body) rides along as the
+  # cross-check; the two must agree near 1 on the unlevered program
+  # (the int8 tower shifts XLA's count, not the model's).
+  flops_per_step = analytic_flops(
+      "qtopt_step", learner=learner, batch_size=batch_size,
+      params=state.train_state.params)
   single = jax.jit(learner.train_step)
-  flops_per_step = profiling.compiled_flops_per_call(
+  xla_flops = profiling.compiled_flops_per_call(
       single.lower(state, transitions, jax.random.PRNGKey(2)).compile())
 
   best, trials, (step, state) = _scan_step_rate(
@@ -327,13 +484,20 @@ def bench_config(paper: bool, profile_dir=None, width: int = 64):
         f"{peak/1e12:.1f} — timing barrier or FLOPs count is broken.")
   return {
       "config": desc,
+      "cem_inference": learner.cem_inference,
       "steps_per_sec_best": round(best, 2),
       "steps_per_sec_median": round(float(np.median(trials)), 2),
       "steps_per_sec_trials": [round(x, 2) for x in trials],
       "steps_per_sec_per_dispatch": round(per_dispatch, 2),
       "scan_steps_per_dispatch": SCAN_STEPS,
       "timing_barrier": "device_to_host",
+      # est_flops_per_step = the ANALYTIC model flops (MFU
+      # denominator, schema v3); xla_flops_per_step = cost analysis of
+      # the compiled (possibly levered) program, for the cross-check.
       "est_flops_per_step": flops_per_step,
+      "xla_flops_per_step": xla_flops,
+      "analytic_vs_xla_flops": (
+          round(flops_per_step / xla_flops, 4) if xla_flops else None),
       "mfu": round(util, 4) if util is not None else None,
       "device_kind": jax.devices()[0].device_kind,
       "peak_bf16_flops": peak,
@@ -901,6 +1065,115 @@ def bench_pod_scaling(scan: int = 200):
   }
 
 
+def bench_mfu_levers(dry_run: bool = False):
+  """The --mfu axis: each ISSUE-7 lever measured on the primary config
+  under the standard scan/D2H methodology, MFU from the SHARED
+  analytic denominator (identical across levers by construction — the
+  whole point of analytic model flops).
+
+  Levers: bf16 vs int8 CEM inference tower × lax vs fused
+  (Pallas running-top-k) select, then remat policies on the critic
+  loss. The committed flagship (what `primary` measures) is whatever
+  `build()` defaults to; this table is the evidence for that choice
+  and the regression surface for the next one. `dry_run`: tiny model,
+  2-step scans, analytic-vs-XLA flops cross-check, no detail write —
+  the tier-1 smoke that every lever still traces and runs.
+  """
+  from tensor2robot_tpu.research.qtopt import (
+      GraspingQModel,
+      QTOptLearner,
+  )
+  from tensor2robot_tpu.specs import make_random_tensors
+  from tensor2robot_tpu.utils import profiling
+
+  if dry_run:
+    scan, trials, batch_size = 2, 1, 8
+    def make_learner(cem_inference, cem_select, remat=None):
+      model = GraspingQModel(
+          image_size=16, torso_filters=(8,), head_filters=(8, 8),
+          dense_sizes=(16,), action_dim=2, remat_policy=remat)
+      return QTOptLearner(model, cem_population=8, cem_iterations=1,
+                          cem_elites=2, cem_inference=cem_inference,
+                          cem_select=cem_select)
+  else:
+    scan, trials, batch_size = SCAN_STEPS, 3, None
+    def make_learner(cem_inference, cem_select, remat=None):
+      _, learner, _, _ = build(False, cem_inference=cem_inference,
+                               cem_select=cem_select)
+      if remat:
+        learner.model._remat_policy = remat  # sweep knob, same model
+      return learner
+
+  def measure(cem_inference, cem_select, remat=None):
+    learner = make_learner(cem_inference, cem_select, remat)
+    bs = batch_size or 256
+    transitions = make_random_tensors(
+        learner.transition_specification(), batch_size=bs, seed=0)
+    transitions = jax.device_put(
+        jax.tree_util.tree_map(np.asarray, transitions))
+    state = learner.create_state(jax.random.PRNGKey(0))
+    model_flops = analytic_flops(
+        "qtopt_step", learner=learner, batch_size=bs,
+        params=state.train_state.params)
+    best, rates, _ = _scan_step_rate(learner, transitions, scan,
+                                     trials, state=state)
+    util = profiling.mfu(best, model_flops)
+    return {
+        "steps_per_sec_best": round(best, 2),
+        "trials": [round(r, 2) for r in rates],
+        "analytic_flops_per_step": model_flops,
+        "mfu": round(util, 4) if util is not None else None,
+    }
+
+  detail = {
+      "config": ("primary bench config per lever; MFU denominator = "
+                 "analytic model flops (shared across levers)"),
+      "device_kind": jax.devices()[0].device_kind,
+      "levers": {},
+      "remat": {},
+  }
+  for inference in ("bf16", "int8"):
+    for select in ("lax", "fused"):
+      detail["levers"][f"{inference}/{select}"] = measure(inference,
+                                                          select)
+  for remat in ("none", "dots", "full"):
+    detail["remat"][remat] = measure(
+        "bf16", "lax", None if remat == "none" else remat)
+  base = detail["levers"]["bf16/lax"]["steps_per_sec_best"]
+  for entry in list(detail["levers"].values()) + list(
+      detail["remat"].values()):
+    entry["speedup_vs_bf16_lax"] = round(
+        entry["steps_per_sec_best"] / max(base, 1e-9), 3)
+
+  if dry_run:
+    # Analytic-vs-XLA cross-check on the tiny unlevered program: the
+    # smoke asserts the shared denominator tracks cost analysis.
+    learner = make_learner("bf16", "lax")
+    state = learner.create_state(jax.random.PRNGKey(0))
+    transitions = make_random_tensors(
+        learner.transition_specification(), batch_size=8, seed=0)
+    transitions = jax.tree_util.tree_map(jnp.asarray, transitions)
+    xla = profiling.compiled_flops_per_call(
+        jax.jit(learner.train_step).lower(
+            state, transitions, jax.random.PRNGKey(2)).compile())
+    analytic = analytic_flops("qtopt_step", learner=learner,
+                              batch_size=8,
+                              params=state.train_state.params)
+    ratio = round(analytic / xla, 4) if xla else None
+    detail["analytic_vs_xla_flops"] = ratio
+    # ENFORCED, not just recorded: a broken analytic model (dropped
+    # term, double count) must fail tier-1, not silently skew every
+    # MFU figure and the regression gate. The band is wide because the
+    # tiny smoke model is elementwise-heavy (measures ~0.86; the
+    # primary config measures 0.996) — it catches structural breakage,
+    # not calibration drift.
+    if ratio is not None and not 0.7 <= ratio <= 1.3:
+      raise RuntimeError(
+          f"analytic_flops diverged from XLA cost analysis "
+          f"(ratio {ratio}); the MFU denominator is broken")
+  return detail
+
+
 def bench_moe(batch: int = 8, t: int = 256, width: int = 256,
               depth: int = 4, experts: int = 8, scan: int = 20):
   """Train-rate cost of enabling MoE on the trunk, on one chip.
@@ -1122,6 +1395,27 @@ def bench_verify_numerics():
       act, enc0, ck, bn_scale, bn_shift, dense, block_b=2))
   results["cem_head_max_err"] = float(np.max(np.abs(cem_got - cem_ref)))
 
+  # Fused CEM select (ops/cem_select.py) compiled vs its lax oracle.
+  # ADVISORY until its first chip run (the kernel shipped from a
+  # CPU-only session, interpret-verified): a Mosaic compile failure is
+  # recorded, not fatal, and the verdict below carries its own flag
+  # (`cem_select_numerics_ok`) instead of gating hardware_numerics_ok.
+  try:
+    from tensor2robot_tpu.ops import cem_select_lax, fused_cem_select
+    pooled = f(64, bb, c)
+    samples = jnp.asarray(rng.standard_normal((bb, 64, 4)),
+                          jnp.float32)
+    sel_dense = ((f(c, 64), f(64)), (f(64, 1), f(1)))
+    want = cem_select_lax(pooled, samples, sel_dense, num_elites=6)
+    got = fused_cem_select(pooled, samples, sel_dense, num_elites=6)
+    sel_err = max(float(jnp.max(jnp.abs(g - w)))
+                  for g, w in zip(got, want))
+    results["cem_select_max_err"] = sel_err
+    results["cem_select_numerics_ok"] = bool(sel_err < 5e-2)
+  except Exception as e:  # noqa: BLE001 — record, don't kill the gate
+    results["cem_select_compile_error"] = repr(e)[:500]
+    results["cem_select_numerics_ok"] = False
+
   # Full train step: this chip vs a CPU subprocess, same seeds.
   tpu_loss, tpu_gn = _verify_qtopt_metrics()
   env = {kk: vv for kk, vv in os.environ.items()
@@ -1223,7 +1517,8 @@ def bench_long_context(t: int = 32768, heads: int = 4, d: int = 64,
       .astype(jnp.float32)))
   from tensor2robot_tpu.utils import profiling
 
-  fwd_flops = 4 * 1 * heads * d * t * t / 2
+  fwd_flops = analytic_flops("attention", b=1, heads=heads, d=d, t=t,
+                             causal=True)
   peak = profiling.device_peak_flops()
   return {
       "config": f"flash attention, T={t} causal, H={heads}, D={d}, "
@@ -1811,6 +2106,20 @@ def main():
             smoke["worker_scaling"]["1"]["speedup_vs_in_process"],
     }))
     return
+  if "--mfu" in args and "--dry-run" in args:
+    # Tier-1 smoke of the MFU-lever bench path: tiny model, every
+    # lever combination traced + run for a 2-step scan, the analytic
+    # FLOPs helper cross-checked against XLA cost analysis, NO
+    # detail-file write.
+    smoke = bench_mfu_levers(dry_run=True)
+    print(json.dumps({
+        "mfu_dry_run": "ok",
+        "device_kind": smoke["device_kind"],
+        "lever_combinations": sorted(smoke["levers"]),
+        "remat_policies": sorted(smoke["remat"]),
+        "analytic_vs_xla_flops": smoke["analytic_vs_xla_flops"],
+    }))
+    return
   if "--serving" in args and "--dry-run" in args:
     # Tier-1 smoke of the serving bench path: tiny model, one small
     # bucket table, local backend, NO detail-file write (a CPU smoke
@@ -1856,10 +2165,16 @@ def main():
   for section in detail.values():
     if isinstance(section, dict):
       section.pop("top_ops_from_prior_profiled_run", None)
-  detail["version"] = 2  # schema: axis sections merge independently
+  # mfu is a FIRST-CLASS field of every Bellman-step section (and of
+  # the one-line parsed output) as of v3, denominated in
+  # analytic_flops(); regression vs the committed primary fails the
+  # run (see the gate at the bottom of main).
+  committed_mfu = (detail.get("primary") or {}).get("mfu")
+  committed_kind = (detail.get("primary") or {}).get("device_kind")
+  detail["version"] = 3  # schema: + first-class analytic mfu
   axis_flags = {"--input", "--replay", "--replayfeed", "--longcontext",
                 "--podscale", "--moe", "--pipeline", "--verify",
-                "--serving", "--coldstart", "--mxu"}
+                "--serving", "--coldstart", "--mxu", "--mfu"}
   axis_only = (bool(args) and not run_paper and profile_dir is None
                and "--primary" not in args
                and all(a in axis_flags for a in args))
@@ -1946,6 +2261,8 @@ def main():
     detail["serving_latency"] = bench_serving()
   if "--coldstart" in args:
     detail["coldstart"] = bench_coldstart()
+  if "--mfu" in args:
+    detail["mfu_levers"] = bench_mfu_levers()
   if "--mxu" in args:
     # The MXU-width primary variant + the committed flagship-width
     # decision (round-5 verdict item 2), with THIS run's numbers
@@ -1976,10 +2293,30 @@ def main():
             "the MXU win for free."),
     }
 
+  # The MFU regression gate (BEFORE the write, so a regressed run can
+  # never replace the committed baseline it failed against): a
+  # re-measured primary on the same device class must not fall below
+  # the committed value (small epsilon for run-to-run jitter in the
+  # BEST-of-N). Axis-only runs reuse the committed primary and never
+  # trip this; hosts where peak flops are unknown (mfu None) can't be
+  # compared and skip it.
+  primary = detail["primary"]
+  new_mfu = primary.get("mfu")
+  if (not axis_only and committed_mfu and new_mfu
+      and primary.get("device_kind") == committed_kind
+      and new_mfu < committed_mfu - 0.002):
+    print(json.dumps({
+        "error": "mfu_regression",
+        "committed_mfu": committed_mfu,
+        "measured_mfu": new_mfu,
+        "note": "refusing to overwrite BENCH_DETAIL.json with a "
+                "regressed primary; treat like a failing test",
+    }), file=sys.stderr)
+    raise SystemExit(1)
+
   with open("BENCH_DETAIL.json", "w") as f:
     json.dump(detail, f, indent=2)
 
-  primary = detail["primary"]
   mfu_note = (f", mfu={primary['mfu']:.1%}" if primary.get("mfu")
               else "")
   print(json.dumps({
@@ -1990,6 +2327,9 @@ def main():
                f"{mfu_note})"),
       "vs_baseline": round(
           primary["steps_per_sec_best"] / PER_CHIP_TARGET, 3),
+      # First-class parsed field (schema v3): achieved/peak with the
+      # analytic model-flops denominator.
+      "mfu": primary.get("mfu"),
   }))
 
 

@@ -35,8 +35,13 @@ echo "--- input bench smoke (bench.py --input --dry-run) ---"
 env JAX_PLATFORMS=cpu python bench.py --input --dry-run
 input_rc=$?
 
+echo "--- mfu bench smoke (bench.py --mfu --dry-run) ---"
+env JAX_PLATFORMS=cpu python bench.py --mfu --dry-run
+mfu_rc=$?
+
 if [ "$rc" -ne 0 ]; then exit "$rc"; fi
 if [ "$smoke_rc" -ne 0 ]; then exit "$smoke_rc"; fi
 if [ "$coldstart_rc" -ne 0 ]; then exit "$coldstart_rc"; fi
 if [ "$replay_rc" -ne 0 ]; then exit "$replay_rc"; fi
-exit "$input_rc"
+if [ "$input_rc" -ne 0 ]; then exit "$input_rc"; fi
+exit "$mfu_rc"

@@ -24,6 +24,15 @@ Dynamic dispatch (a function object arriving through a parameter) is
 not followed — the linter under-approximates reachability rather than
 drowning the repo in speculative findings. docs/ANALYSIS.md states the
 contract.
+
+Pallas-aware (ISSUE 7): ``pallas_call`` kernels (named directly, via
+``functools.partial``, or via a variable bound to such a partial) are
+device code — scanned for JAX201/202/204 like any traced function,
+with two kernel-specific carve-outs: calls into the
+``jax.experimental.pallas`` namespace (``pl.load``/``pl.store``/ref
+indexing helpers) are device memory ops, never host syncs; and the
+JAX203 Python-branch heuristics are skipped inside kernels, where
+branching over static block/grid parameters is the idiom.
 """
 
 from __future__ import annotations
@@ -60,6 +69,16 @@ _TRACING_DECORATORS = {
     "jax.jit", "jit", "pjit", "jax.pjit", "jax.checkpoint",
     "jax.remat", "checkpoint", "remat", "partial", "functools.partial",
 }
+
+# Pallas kernel entries: the FIRST argument of pallas_call is device
+# code (Mosaic), scanned for JAX201/202/204 like any traced function —
+# but NOT for JAX203: Python control flow over static block/grid
+# parameters is the Pallas idiom, not a tracer-branch hazard, so
+# kernels are marked traced-indirect. Calls INTO the pallas namespace
+# (pl.load / pl.store / pl.program_id / ref indexing helpers) are
+# device memory ops, never host syncs — exempted wholesale.
+_PALLAS_CALLS = {"jax.experimental.pallas.pallas_call", "pallas_call"}
+_PALLAS_NAMESPACE = "jax.experimental.pallas"
 
 # JAX201 — host syncs.
 _SYNC_CALLS = {
@@ -117,8 +136,66 @@ class _TracedSet:
     return self.traced.get((id(module), qualname), False)
 
 
+def _scope_qualname(module: Module, node: ast.AST) -> str:
+  enclosing = module.enclosing_function(node)
+  return getattr(enclosing, "qualname", None) or "<module>"
+
+
+def _partial_kernel_map(module: Module) -> Dict[Tuple[str, str], str]:
+  """{(scope_qualname, var_name): function_qualname} for
+  `var = functools.partial(fn, ...)` assignments whose fn is a module
+  function — the idiom every in-repo Pallas kernel uses before handing
+  `var` to pallas_call. Keyed by the enclosing function so two scopes
+  reusing a variable name (e.g. both calling it `kernel`) resolve to
+  their own kernels instead of colliding module-wide."""
+  out: Dict[Tuple[str, str], str] = {}
+  for node in ast.walk(module.tree):
+    if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and isinstance(node.value, ast.Call)):
+      continue
+    callee = module.expand(dotted_name(node.value.func))
+    if callee not in ("partial", "functools.partial"):
+      continue
+    if not node.value.args:
+      continue
+    inner = dotted_name(node.value.args[0])
+    if inner and inner in module.functions:
+      out[(_scope_qualname(module, node),
+           node.targets[0].id)] = inner
+  return out
+
+
 def _find_entries(ts: _TracedSet) -> None:
   for module in ts.modules:
+    partial_kernels = _partial_kernel_map(module)
+    # Pallas kernels: pallas_call's first argument (a function name, a
+    # functools.partial over one, or a variable bound to such a
+    # partial) runs as device code — traced-INDIRECT (JAX201/202/204
+    # scanned, JAX203's Python-branch heuristics skipped: branching on
+    # static block parameters is the kernel idiom).
+    for node in ast.walk(module.tree):
+      if not isinstance(node, ast.Call):
+        continue
+      if module.expand(call_name(node)) not in _PALLAS_CALLS:
+        continue
+      arg = _first_call_arg(node)
+      if arg is None:
+        continue
+      kernel = None
+      if isinstance(arg, ast.Call) and module.expand(
+          dotted_name(arg.func)) in ("partial", "functools.partial") \
+          and arg.args:
+        kernel = dotted_name(arg.args[0])
+      else:
+        name = dotted_name(arg)
+        if name:
+          scope = _scope_qualname(module, node)
+          kernel = partial_kernels.get(
+              (scope, name),
+              partial_kernels.get(("<module>", name), name))
+      if kernel and kernel in module.functions:
+        ts.mark(module, kernel, direct=False)
     # Decorated functions.
     for qual, info in module.functions.items():
       for dec in info.node.decorator_list:
@@ -193,6 +270,8 @@ def _scan_traced_body(module: Module, scope: str, body: ast.AST,
     if isinstance(node, ast.Call):
       name = call_name(node)
       expanded = module.expand(name)
+      if expanded and expanded.startswith(_PALLAS_NAMESPACE):
+        continue  # pl.load/pl.store/...: device memory ops, not syncs
       if name and (name in _SYNC_CALLS or expanded in _SYNC_CALLS
                    or name.endswith(_SYNC_METHOD_SUFFIXES)):
         findings.append(Finding(

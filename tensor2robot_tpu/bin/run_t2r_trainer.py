@@ -49,6 +49,12 @@ flags.DEFINE_bool(
     "jax_init_distributed", False,
     "Force jax.distributed.initialize() even without an explicit "
     "coordinator (TPU pod auto-discovery).")
+flags.DEFINE_enum(
+    "trainer", "train_eval", ["train_eval", "qtopt"],
+    "Entry to run after gin parsing: the supervised "
+    "train_eval_model() loop (default) or the QT-Opt learner loop "
+    "(train_qtopt — configs binding train_qtopt.*, e.g. "
+    "research/qtopt/configs/qtopt_int8.gin).")
 
 # Configurable registration happens at import; pull in every in-tree
 # family so configs can reference them without import lines.
@@ -103,7 +109,11 @@ def main(argv):
   )
   _import_configurable_families()
   gin.parse_config_files_and_bindings(configs, FLAGS.gin_bindings)
-  train_eval.train_eval_model()
+  if FLAGS.trainer == "qtopt":
+    from tensor2robot_tpu.research.qtopt.train_qtopt import train_qtopt
+    train_qtopt()
+  else:
+    train_eval.train_eval_model()
 
 
 def _import_configurable_families() -> None:

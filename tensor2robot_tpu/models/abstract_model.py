@@ -73,7 +73,8 @@ class AbstractT2RModel(ModelInterface):
                create_optimizer_fn: Callable = opt_lib.create_optimizer,
                init_from_checkpoint_path: Optional[str] = None,
                device_dtype=jnp.float32,
-               aux_loss_weight: float = 0.01):
+               aux_loss_weight: float = 0.01,
+               remat_policy: Optional[str] = None):
     """Args:
       preprocessor_cls: class (or factory) called with the two model spec
         getter fns; defaults to NoOpPreprocessor.
@@ -86,12 +87,22 @@ class AbstractT2RModel(ModelInterface):
       aux_loss_weight: weight on auxiliary losses the network sows into
         the "aux_loss" collection (e.g. the MoE load-balance loss);
         irrelevant for networks that sow none.
+      remat_policy: rematerialization of the loss forward under the
+        gradient (docs/PERF.md sweep knob): None/"none" keeps XLA's
+        default (save everything), "full" = jax.checkpoint saving
+        nothing, "dots" = save MXU outputs only
+        (checkpoint_dots), "dots_no_batch" = save only batch-free dot
+        outputs (dots_with_no_batch_dims_saveable). Remat trades HBM
+        residency of forward activations for recompute — at large
+        batch that headroom buys bigger fused K-step programs. Bitwise
+        identical math (recompute is exact; pinned by tests).
     """
     self._preprocessor_cls = preprocessor_cls
     self._create_optimizer_fn = create_optimizer_fn
     self._init_from_checkpoint_path = init_from_checkpoint_path
     self._device_dtype = device_dtype
     self._aux_loss_weight = aux_loss_weight
+    self._remat_policy = remat_policy
     self._preprocessor = None
     self._network = None
     self._tx = None
@@ -136,6 +147,13 @@ class AbstractT2RModel(ModelInterface):
     if self._tx is None:
       self._tx = self._create_optimizer_fn()
     return self._tx
+
+  def wrap_optimizer(self, wrapper: Callable) -> None:
+    """Replaces the optimizer with `wrapper(tx)` — the trainer-side
+    hook for mesh-dependent transformations (e.g.
+    `optimizers.shard_weight_update`, which needs the mesh that only
+    the training loop knows). Call before the step is traced."""
+    self._tx = wrapper(self.tx)
 
   AUX_LOSS_OUTPUT = "_aux_loss"
 
@@ -306,9 +324,33 @@ class AbstractT2RModel(ModelInterface):
       scalars = {**scalars, "aux_loss": aux}
     return loss, (scalars, new_stats)
 
+  def _loss_for_grad(self) -> Callable:
+    """`loss_fn`, optionally under jax.checkpoint per `remat_policy`.
+
+    `mode` (arg 5) is static — an enum, not a tracer. Recompute is
+    exact arithmetic, so every policy is bitwise-equal to "none"; the
+    choice only moves the HBM-vs-recompute trade (docs/PERF.md).
+    """
+    policy_name = self._remat_policy
+    if policy_name in (None, "none"):
+      return self.loss_fn
+    policies = {
+        "full": None,
+        "dots": "checkpoint_dots",
+        "dots_no_batch": "dots_with_no_batch_dims_saveable",
+    }
+    if policy_name not in policies:
+      raise ValueError(
+          f"remat_policy={policy_name!r} not in "
+          f"{['none'] + sorted(policies)}")
+    attr = policies[policy_name]
+    policy = getattr(jax.checkpoint_policies, attr) if attr else None
+    return jax.checkpoint(self.loss_fn, policy=policy,
+                          static_argnums=(5,))
+
   def train_step(self, state: TrainState, features, labels,
                  rng: jax.Array) -> Tuple[TrainState, Dict[str, jax.Array]]:
-    grad_fn = jax.value_and_grad(self.loss_fn, has_aux=True)
+    grad_fn = jax.value_and_grad(self._loss_for_grad(), has_aux=True)
     (loss, (scalars, new_stats)), grads = grad_fn(
         state.params, state.batch_stats, features, labels, rng, Mode.TRAIN)
     updates, new_opt_state = self.tx.update(grads, state.opt_state,

@@ -1,0 +1,224 @@
+"""Pallas fused CEM scoring + running arg-top-k + elite-stats kernel.
+
+The CEM inner loop (`research/qtopt/cem.py`) scores a [B, P] population
+through the Q-head MLP, runs `lax.top_k`, gathers the elite actions,
+and reduces them to a refreshed mean/std — four XLA ops with the full
+[B, P] score tensor and an [B, E, A] elite gather materialized between
+them. This kernel fuses the whole tail of one CEM iteration: the
+q-head MLP applied to the pooled population features, a RUNNING top-k
+over sample blocks (flash-attention-style: merge each block's
+candidates into the kept elite set, so no full score tensor ever
+exists), and the elite mean/std/best reduction — one HBM read of the
+pooled features, four [B, ·] rows out.
+
+Selection semantics are EXACTLY `lax.top_k`'s: ties broken toward the
+lower sample index. The running merge preserves that globally because
+kept elites always precede the current block in combined order (see
+`_select_top` — the proof is in tests/test_cem_select.py's tie cases).
+
+Numerics: MLP GEMMs accumulate in f32 (`preferred_element_type`) from
+the caller's operand dtype; all selection/statistics math is f32. The
+`cem_select_lax` reference implements the identical contract in plain
+lax and is the parity oracle for the interpret-mode CPU tests; on
+hardware the compiled kernel is gated by `bench.py --mfu` / `--verify`
+(tolerances in the `ops/flash_attention.py` style — interpret exact,
+hardware at MXU-epsilon bars).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG_INF = float("-inf")
+_LANES = 128
+
+
+def _mlp_f32(x, flat_dense):
+  """The q-head MLP with f32 accumulation; x [N, C] → [N, 1] f32."""
+  h = x
+  num_dense = len(flat_dense) // 2
+  for layer in range(num_dense):
+    w, b = flat_dense[2 * layer], flat_dense[2 * layer + 1]
+    h = jax.lax.dot_general(
+        h, w[...], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) + \
+        b[...].astype(jnp.float32)
+    if layer < num_dense - 1:
+      h = jnp.maximum(h, 0.0).astype(x.dtype)
+  return h  # [N, 1] f32
+
+
+def _select_top(scores, actions, num_elites):
+  """Iterative top-k with lax.top_k tie semantics (first index wins).
+
+  scores [N, 1] f32 (−inf = masked), actions [N, A] f32. Returns
+  (top_scores [E, 1], top_actions [E, A]) in descending score order.
+  """
+  n = scores.shape[0]
+  idx = jax.lax.broadcasted_iota(jnp.int32, (n, 1), 0)
+  top_s, top_a = [], []
+  work = scores
+  for _ in range(num_elites):
+    m = jnp.max(work)
+    first = jnp.min(jnp.where(work == m, idx, n))
+    onehot = (idx == first).astype(jnp.float32)  # [N, 1]
+    top_s.append(m.reshape(1, 1))
+    top_a.append(jnp.sum(onehot * actions, axis=0, keepdims=True))
+    work = jnp.where(onehot > 0, _NEG_INF, work)
+  return jnp.concatenate(top_s, axis=0), jnp.concatenate(top_a, axis=0)
+
+
+def _cem_select_kernel(pooled_ref, samples_ref, *rest, block_b: int,
+                       p: int, c: int, a_dim: int, num_elites: int,
+                       block_p: int, min_std: float, sigmoid: bool,
+                       compute_dtype):
+  """One grid cell: `block_b` states' full populations → elite stats."""
+  flat_dense = rest[:-1]
+  out_ref = rest[-1]
+  chunks = -(-p // block_p)  # ceil
+  p_pad = chunks * block_p
+
+  for b in range(block_b):
+    x = pooled_ref[:, b].astype(compute_dtype)        # [P, C]
+    acts = samples_ref[b].astype(jnp.float32)         # [P, A]
+    if p_pad != p:
+      x = jnp.concatenate(
+          [x, jnp.zeros((p_pad - p, c), x.dtype)], axis=0)
+      acts = jnp.concatenate(
+          [acts, jnp.zeros((p_pad - p, a_dim), acts.dtype)], axis=0)
+
+    top_s = jnp.full((num_elites, 1), _NEG_INF, jnp.float32)
+    top_a = jnp.zeros((num_elites, a_dim), jnp.float32)
+    for ci in range(chunks):
+      lo = ci * block_p
+      s = _mlp_f32(x[lo:lo + block_p], flat_dense)     # [bp, 1]
+      if sigmoid:
+        s = jax.nn.sigmoid(s)
+      row = lo + jax.lax.broadcasted_iota(jnp.int32, (block_p, 1), 0)
+      s = jnp.where(row < p, s, _NEG_INF)
+      # Merge kept elites with this block; kept entries come FIRST in
+      # combined order, so a tie between a kept elite (earlier global
+      # index by construction) and a new candidate resolves to the
+      # kept one — the global lax.top_k tie order.
+      comb_s = jnp.concatenate([top_s, s], axis=0)
+      comb_a = jnp.concatenate([top_a, acts[lo:lo + block_p]], axis=0)
+      top_s, top_a = _select_top(comb_s, comb_a, num_elites)
+
+    mean = jnp.mean(top_a, axis=0, keepdims=True)       # [1, A]
+    var = jnp.mean((top_a - mean) ** 2, axis=0, keepdims=True)
+    std = jnp.maximum(jnp.sqrt(var), min_std)
+    pad = jnp.zeros((1, _LANES - a_dim), jnp.float32)
+    rows = jnp.concatenate([
+        jnp.concatenate([mean, pad], axis=1),
+        jnp.concatenate([std, pad], axis=1),
+        jnp.concatenate([top_a[0:1], pad], axis=1),
+        jnp.broadcast_to(top_s[0:1], (1, _LANES)),
+    ], axis=0)                                          # [4, 128]
+    out_ref[b] = rows
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_elites", "min_std", "sigmoid",
+                              "interpret", "block_p", "block_b"))
+def fused_cem_select(
+    pooled: jax.Array,
+    samples: jax.Array,
+    dense_params: Tuple[Tuple[jax.Array, jax.Array], ...],
+    num_elites: int,
+    min_std: float = 1e-2,
+    sigmoid: bool = False,
+    interpret: bool = False,
+    block_p: int = 64,
+    block_b: int = 2,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+  """Fused CEM iteration tail. Returns (mean, std, best_action,
+  best_score) — mean/std/best_action [B, A] f32, best_score [B] f32.
+
+  Args:
+    pooled: [P, B, C] pooled population features in P-MAJOR order (the
+      natural reshape of `GraspingQNetwork.pool_population`'s P-major
+      GEMM output — no transpose on the hot path).
+    samples: [B, P, A] the candidate actions that produced `pooled`.
+    dense_params: ((w, b), ...) of the q-head MLP; final width 1.
+    num_elites: E; the running top-k width.
+    min_std: floor applied to the elite std (CEM contract).
+    sigmoid: apply sigmoid to scores before selection (the
+      `sigmoid_q` grasp-success head semantics; monotone, so selection
+      is unchanged but best_score is reported on the sigmoid scale).
+    interpret: pallas interpret mode (CPU tests).
+    block_p: sample-block width of the running top-k; P need NOT be a
+      multiple (the tail block is index-masked to −inf).
+    block_b: states per grid cell; falls back to 1 when B % block_b.
+  """
+  p, b, c = pooled.shape
+  if samples.shape[:2] != (b, p):
+    raise ValueError(f"samples {samples.shape} != [B={b}, P={p}, A]")
+  a_dim = samples.shape[-1]
+  if a_dim > _LANES:
+    raise ValueError(f"action_dim {a_dim} > {_LANES} unsupported")
+  if num_elites > p:
+    raise ValueError(f"num_elites {num_elites} > population {p}")
+  if dense_params[-1][0].shape[-1] != 1:
+    raise ValueError("q-head MLP must end at width 1")
+  block_b = block_b if b % block_b == 0 else 1
+  block_p = min(block_p, max(p, 1))
+
+  flat_dense = []
+  for w, bias in dense_params:
+    flat_dense += [w, bias.reshape(1, -1)]
+
+  kernel = functools.partial(
+      _cem_select_kernel, block_b=block_b, p=p, c=c, a_dim=a_dim,
+      num_elites=num_elites, block_p=block_p, min_std=min_std,
+      sigmoid=sigmoid, compute_dtype=pooled.dtype)
+  full = lambda *shape: pl.BlockSpec(  # noqa: E731
+      shape, lambda i: (0,) * len(shape))
+  out = pl.pallas_call(
+      kernel,
+      grid=(b // block_b,),
+      in_specs=[
+          pl.BlockSpec((p, block_b, c), lambda i: (0, i, 0)),
+          pl.BlockSpec((block_b, p, a_dim), lambda i: (i, 0, 0)),
+      ] + [full(*x.shape) for x in flat_dense],
+      out_specs=pl.BlockSpec((block_b, 4, _LANES),
+                             lambda i: (i, 0, 0)),
+      out_shape=jax.ShapeDtypeStruct((b, 4, _LANES), jnp.float32),
+      interpret=interpret,
+  )(pooled, samples.astype(jnp.float32), *flat_dense)
+  return (out[:, 0, :a_dim], out[:, 1, :a_dim], out[:, 2, :a_dim],
+          out[:, 3, 0])
+
+
+def cem_select_lax(
+    pooled: jax.Array,
+    samples: jax.Array,
+    dense_params: Tuple[Tuple[jax.Array, jax.Array], ...],
+    num_elites: int,
+    min_std: float = 1e-2,
+    sigmoid: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+  """The kernel's contract in plain lax — the parity oracle.
+
+  Same signature and numerics policy (f32-accumulated MLP, f32
+  selection/statistics, lax.top_k tie order); materializes the full
+  score tensor the kernel exists to avoid.
+  """
+  p, b, c = pooled.shape
+  scores = _mlp_f32(pooled.reshape(p * b, c),
+                    [x if x.ndim == 2 else x.reshape(1, -1)
+                     for pair in dense_params for x in
+                     (pair[0], pair[1])])
+  scores = scores.reshape(p, b).T  # [B, P]
+  if sigmoid:
+    scores = jax.nn.sigmoid(scores)
+  elite_scores, elite_idx = jax.lax.top_k(scores, num_elites)
+  elites = jnp.take_along_axis(
+      samples.astype(jnp.float32), elite_idx[..., None], axis=1)
+  mean = jnp.mean(elites, axis=1)
+  std = jnp.maximum(jnp.std(elites, axis=1), min_std)
+  return mean, std, elites[:, 0], elite_scores[:, 0]

@@ -33,9 +33,11 @@ from tensor2robot_tpu.parallel.ring_attention import (
     sequence_sharding,
 )
 from tensor2robot_tpu.parallel.sharding import (
+    data_update_sharding,
     expert_sharding,
     fsdp_sharding,
     pipeline_sharding,
     state_sharding,
     tensor_parallel_sharding,
+    train_state_update_sharding,
 )

@@ -24,6 +24,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tensor2robot_tpu.parallel.mesh import (
+    DATA_AXIS,
     EXPERT_AXIS,
     FSDP_AXIS,
     MODEL_AXIS,
@@ -168,6 +169,62 @@ def pipeline_sharding(mesh: Mesh, tree: Any,
     return fsdp_sharding(mesh, leaf, min_size_to_shard)
 
   return jax.tree_util.tree_map_with_path(rule, tree)
+
+
+def data_update_sharding(
+    mesh: Mesh,
+    tree: Any,
+    min_size_to_shard: int = 2 ** 10,
+) -> Any:
+  """Largest-divisible-dim sharding over the DATA axis for each leaf.
+
+  The weight-update sharding of "Automatic Cross-Replica Sharding of
+  Weight Update in Data-Parallel Training" (PAPERS.md): params stay
+  replicated for the forward/backward, but the optimizer's gradients,
+  moments, and update math are sharded across the data-parallel
+  replicas — GSPMD turns the gradient all-reduce into reduce-scatter,
+  each replica updates 1/N of the weights, and one all-gather
+  republishes them. Same leaf rule as `fsdp_sharding`, on `data`.
+  """
+  if DATA_AXIS not in mesh.axis_names:
+    repl = NamedSharding(mesh, P())
+    return jax.tree_util.tree_map(lambda _: repl, tree)
+  size = mesh.shape[DATA_AXIS]
+
+  def rule(leaf):
+    shape = getattr(leaf, "shape", ())
+    if not shape or int(np.prod(shape)) < min_size_to_shard:
+      return NamedSharding(mesh, P())
+    order = sorted(range(len(shape)), key=lambda i: -shape[i])
+    for dim in order:
+      if shape[dim] % size == 0:
+        spec = [None] * len(shape)
+        spec[dim] = DATA_AXIS
+        return NamedSharding(mesh, P(*spec))
+    return NamedSharding(mesh, P())
+
+  return jax.tree_util.tree_map(rule, tree)
+
+
+def train_state_update_sharding(mesh: Mesh, state: Any,
+                                min_size_to_shard: int = 2 ** 10
+                                ) -> Any:
+  """Shardings for a TrainState-bearing pytree with the optimizer
+  state sharded over the data axis and everything else replicated.
+
+  Keys on the `TrainState.opt_state` field name: every leaf under a
+  path segment named ``opt_state`` follows `data_update_sharding`;
+  params/batch_stats/step (and a QTOptState's target net) replicate.
+  Pass the result as the state's device_put/in_shardings AND
+  out_shardings — a replicated out_sharding on opt_state would
+  all-gather the moments back every step and erase the win.
+  """
+  def rule(path, leaf):
+    if any(_path_key_name(key) == "opt_state" for key in path):
+      return data_update_sharding(mesh, leaf, min_size_to_shard)
+    return NamedSharding(mesh, P())
+
+  return jax.tree_util.tree_map_with_path(rule, state)
 
 
 def replicated_sharding(mesh: Mesh, tree: Any,

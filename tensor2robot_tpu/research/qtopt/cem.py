@@ -25,7 +25,7 @@ class CEMResult(NamedTuple):
 
 
 def cem_maximize(
-    score_fn: Callable[[jax.Array], jax.Array],
+    score_fn: Optional[Callable[[jax.Array], jax.Array]],
     rng: jax.Array,
     batch_size: int,
     action_dim: int,
@@ -37,23 +37,34 @@ def cem_maximize(
     init_mean: Optional[jax.Array] = None,
     init_std: Optional[jax.Array] = None,
     min_std: float = 1e-2,
+    select_fn: Optional[Callable] = None,
 ) -> CEMResult:
   """Maximizes `score_fn` over actions per batch element.
 
   Args:
     score_fn: [B, P, A] actions → [B, P] scores. The caller folds the
       population into the network batch dim (reshape), so every Q eval
-      rides the MXU at batch B*P.
+      rides the MXU at batch B*P. May be None when `select_fn` is
+      given.
     rng: PRNG key.
     batch_size, action_dim: static sizes.
     iterations/population/num_elites: CEM hyperparameters (QT-Opt used
       3 rounds, 64 samples, 10% elites).
     low/high: action box bounds (scalar or [A] broadcastable).
     init_mean/init_std: optional [B, A] warm start.
-
-  Returns CEMResult with the best action seen across ALL iterations
-  (not just the final mean — the max matters for Bellman targets).
+    select_fn: optional fused replacement of the score→top-k→elite-
+      stats tail: ([B, P, A] samples, min_std) → (elite_mean [B, A],
+      elite_std [B, A] floored at the passed min_std, best_action
+      [B, A], best_score [B]), with lax.top_k tie semantics. The
+      min_std argument is this function's own `min_std` — forwarded so
+      the two paths can never floor differently. The learner wires
+      `ops.fused_cem_select` through this seam so scoring, the running
+      arg-top-k, and the elite reduction run as ONE kernel without
+      materializing the [B, P] score tensor; any callable honoring the
+      contract works (tests pin equivalence against the default path).
   """
+  if score_fn is None and select_fn is None:
+    raise ValueError("one of score_fn / select_fn is required")
   low = jnp.asarray(low, jnp.float32)
   high = jnp.asarray(high, jnp.float32)
   mean = (jnp.zeros((batch_size, action_dim)) + (low + high) / 2.0
@@ -67,16 +78,19 @@ def cem_maximize(
         it_rng, (batch_size, population, action_dim))
     samples = mean[:, None, :] + std[:, None, :] * noise
     samples = jnp.clip(samples, low, high)
-    scores = score_fn(samples)  # [B, P]
 
-    elite_scores, elite_idx = jax.lax.top_k(scores, num_elites)
-    elites = jnp.take_along_axis(
-        samples, elite_idx[..., None], axis=1)  # [B, E, A]
-    new_mean = jnp.mean(elites, axis=1)
-    new_std = jnp.maximum(jnp.std(elites, axis=1), min_std)
-
-    it_best = elites[:, 0]              # top-1 this iteration
-    it_best_score = elite_scores[:, 0]
+    if select_fn is not None:
+      new_mean, new_std, it_best, it_best_score = select_fn(samples,
+                                                            min_std)
+    else:
+      scores = score_fn(samples)  # [B, P]
+      elite_scores, elite_idx = jax.lax.top_k(scores, num_elites)
+      elites = jnp.take_along_axis(
+          samples, elite_idx[..., None], axis=1)  # [B, E, A]
+      new_mean = jnp.mean(elites, axis=1)
+      new_std = jnp.maximum(jnp.std(elites, axis=1), min_std)
+      it_best = elites[:, 0]              # top-1 this iteration
+      it_best_score = elite_scores[:, 0]
     improved = it_best_score > best_score
     best_action = jnp.where(improved[:, None], it_best, best_action)
     best_score = jnp.maximum(best_score, it_best_score)

@@ -64,7 +64,29 @@ class QTOptLearner:
                action_low: float = -1.0,
                action_high: float = 1.0,
                target_update_tau: float = 0.05,
-               clip_targets: Optional[Tuple[float, float]] = (0.0, 1.0)):
+               clip_targets: Optional[Tuple[float, float]] = (0.0, 1.0),
+               cem_inference: str = "bf16",
+               cem_select: str = "lax"):
+    """See class docstring; the two perf levers (docs/PERF.md):
+
+    cem_inference: "bf16" (the network's compute dtype, exact) or
+      "int8" — the CEM Q-tower forward runs the quantized tower
+      (`networks.quantize_tower`): int8 weights/activations, bf16
+      accumulation, activation scales from `calibrate()` (a held-out
+      batch) — halves the HBM traffic of the profiled-hottest merged
+      population tensor. Bellman targets/acting only; the critic
+      gradient path is untouched.
+    cem_select: "lax" (top_k + gather, exact reference) or "fused" —
+      scoring + running arg-top-k + elite stats run as one Pallas
+      kernel (`ops.fused_cem_select`) through `cem_maximize`'s
+      select_fn seam; interpret-mode on CPU backends.
+    """
+    if cem_inference not in ("bf16", "int8"):
+      raise ValueError(f"cem_inference={cem_inference!r} not in "
+                       "('bf16', 'int8')")
+    if cem_select not in ("lax", "fused"):
+      raise ValueError(f"cem_select={cem_select!r} not in "
+                       "('lax', 'fused')")
     self._model = model
     self._gamma = gamma
     self._cem_iterations = cem_iterations
@@ -74,6 +96,12 @@ class QTOptLearner:
     self._action_high = action_high
     self._tau = target_update_tau
     self._clip_targets = clip_targets if model.sigmoid_q else None
+    self._cem_inference = cem_inference
+    self._cem_select = cem_select
+    self._act_scales: Optional[Dict[str, float]] = None
+    # Pallas compiles Mosaic on TPU only; every other backend runs the
+    # fused kernel through the interpreter (exact, just not fast).
+    self._fused_interpret = jax.default_backend() != "tpu"
 
   @property
   def model(self) -> GraspingQModel:
@@ -95,15 +123,123 @@ class QTOptLearner:
     target = jax.tree_util.tree_map(jnp.copy, train_state.params)
     return QTOptState(train_state=train_state, target_params=target)
 
-  def _score_fn(self, variables, state_features):
-    """CEM score fn; encode-once when the network is split that way."""
+  # ---- int8 calibration ----
+
+  @property
+  def cem_inference(self) -> str:
+    return self._cem_inference
+
+  @property
+  def needs_calibration(self) -> bool:
+    """True when the int8 tower is selected but no activation scales
+    exist yet — `calibrate()` (or `ensure_calibrated()`) must run
+    before the step/policy is traced."""
+    return self._cem_inference == "int8" and self._act_scales is None
+
+  def calibrate(self, state, features) -> Dict[str, float]:
+    """Computes the int8 activation scales from a held-out batch.
+
+    Host-level (runs a jitted eval forward); the resulting per-tensor
+    scales are plain floats that bake into subsequently traced
+    steps/policies as constants. `state` is a QTOptState or TrainState
+    (online params — at calibration time target ≈ online); `features`
+    is a batch conforming to the model's TRAIN feature spec (the
+    transition batch's s-side keys work).
+    """
+    from tensor2robot_tpu.research.qtopt import networks as net_lib
+    ts = state.train_state if isinstance(state, QTOptState) else state
+    variables = {"params": ts.params}
+    if ts.batch_stats:
+      variables["batch_stats"] = ts.batch_stats
+    flat = (features.to_flat_dict()
+            if hasattr(features, "to_flat_dict") else dict(features))
+    flat = {k: v for k, v in flat.items()
+            if not k.startswith("next_") and k not in ("reward",
+                                                       "done")}
+    stats = jax.jit(functools.partial(
+        self._model.network.apply, method="calibration_stats"))(
+            variables, flat)
+    self._act_scales = net_lib.scales_from_stats(
+        jax.device_get(stats))
+    return self._act_scales
+
+  def ensure_calibrated(self, state) -> None:
+    """Calibrates from a spec-random batch when nothing better ran —
+    serving contexts that never see a replay batch. Random uint8
+    images land in the same post-BN activation range class as real
+    frames; prefer `calibrate()` on real data when available."""
+    if not self.needs_calibration:
+      return
+    from tensor2robot_tpu.specs import make_random_tensors
+    from tensor2robot_tpu.data.abstract_input_generator import Mode
+    batch = make_random_tensors(
+        self._model.get_feature_specification(Mode.TRAIN),
+        batch_size=16, seed=0)
+    batch = jax.tree_util.tree_map(jnp.asarray, batch)
+    self.calibrate(state, batch)
+
+  # ---- CEM scoring/selection construction ----
+
+  def _cem_fns(self, variables, state_features):
+    """(score_fn, select_fn) for `cem_maximize` — exactly one is used.
+
+    The four gin-selectable paths: bf16/int8 tower × lax/fused select.
+    All encode-split paths run the torso ONCE per state; int8 swaps
+    the tower forward for the quantized twin; "fused" routes the
+    scoring tail through `ops.fused_cem_select` via the select seam.
+    """
     network = self._model.network
-    if hasattr(network, "encode") and hasattr(network, "head"):
+    if not (hasattr(network, "encode") and hasattr(network, "head")):
+      return cem.make_q_score_fn(
+          functools.partial(network.apply), variables, state_features,
+          q_key=Q_VALUE), None
+    if self._cem_inference == "bf16" and self._cem_select == "lax":
       return cem.make_encoded_q_score_fn(
-          network, variables, state_features, q_key=Q_VALUE)
-    return cem.make_q_score_fn(
-        functools.partial(network.apply), variables, state_features,
-        q_key=Q_VALUE)
+          network, variables, state_features, q_key=Q_VALUE), None
+
+    from tensor2robot_tpu.ops import fused_cem_select
+    from tensor2robot_tpu.research.qtopt import networks as net_lib
+    flat_state = dict(state_features.to_flat_dict()
+                      if hasattr(state_features, "to_flat_dict")
+                      else state_features)
+    image = flat_state.pop("image")
+    extras = {k: v for k, v in flat_state.items() if k != "action"}
+    if self._cem_inference == "int8":
+      if self._act_scales is None:
+        raise RuntimeError(
+            "cem_inference='int8' needs activation scales: call "
+            "learner.calibrate(state, batch) (or ensure_calibrated) "
+            "before tracing the step/policy.")
+      tower = net_lib.quantize_tower(network, variables,
+                                     self._act_scales)
+      encoded = net_lib.quantized_encode(network, tower, image)
+      score_fn = lambda actions: net_lib.quantized_score_population(  # noqa: E731
+          network, tower, variables, encoded, extras, actions)
+      pool_fn = lambda actions: net_lib.quantized_pool_population(  # noqa: E731
+          network, tower, variables, encoded, extras, actions)
+    else:
+      encoded = network.apply(variables, image, train=False,
+                              method="encode")
+      score_fn = lambda actions: network.apply(  # noqa: E731
+          variables, encoded, extras, actions,
+          method="score_population")
+      pool_fn = lambda actions: network.apply(  # noqa: E731
+          variables, encoded, extras, actions,
+          method="pool_population")
+    if self._cem_select != "fused":
+      return score_fn, None
+
+    dense = net_lib.q_head_dense_params(variables,
+                                        dtype=network.dtype)
+    sigmoid = self._model.sigmoid_q
+
+    def select_fn(actions, min_std):
+      return fused_cem_select(
+          pool_fn(actions), actions, dense,
+          num_elites=self._cem_elites, min_std=min_std,
+          sigmoid=sigmoid, interpret=self._fused_interpret)
+
+    return None, select_fn
 
   # ---- target computation ----
 
@@ -115,18 +251,24 @@ class QTOptLearner:
     if batch_stats:
       variables["batch_stats"] = batch_stats
     batch = jax.tree_util.tree_leaves(next_features)[0].shape[0]
-    score_fn = self._score_fn(variables, next_features)
+    score_fn, select_fn = self._cem_fns(variables, next_features)
 
-    def sigmoid_score(actions):
-      q = score_fn(actions)
-      return jax.nn.sigmoid(q) if self._model.sigmoid_q else q
+    sigmoid_score = None
+    if score_fn is not None:
+      def sigmoid_score(actions):
+        q = score_fn(actions)
+        return jax.nn.sigmoid(q) if self._model.sigmoid_q else q
+    # select_fn case: the sigmoid (monotone — selection unchanged)
+    # runs inside the fused kernel, so best_score is already on the
+    # sigmoid scale (_cem_fns passes sigmoid=model.sigmoid_q).
 
     result = cem.cem_maximize(
         sigmoid_score, rng, batch, self._model.action_dim,
         iterations=self._cem_iterations,
         population=self._cem_population,
         num_elites=self._cem_elites,
-        low=self._action_low, high=self._action_high)
+        low=self._action_low, high=self._action_high,
+        select_fn=select_fn)
     return result.best_score
 
   # ---- the fused train step ----
@@ -200,12 +342,13 @@ class QTOptLearner:
       if ts.batch_stats:
         variables["batch_stats"] = ts.batch_stats
       batch = jax.tree_util.tree_leaves(observations)[0].shape[0]
-      score_fn = self._score_fn(variables, observations)
+      score_fn, select_fn = self._cem_fns(variables, observations)
       result = cem.cem_maximize(
           score_fn, rng, batch, self._model.action_dim,
           iterations=iterations, population=population,
           num_elites=self._cem_elites,
-          low=self._action_low, high=self._action_high)
+          low=self._action_low, high=self._action_high,
+          select_fn=select_fn)
       return result.best_action
 
     return policy

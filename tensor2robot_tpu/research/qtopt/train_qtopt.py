@@ -21,6 +21,7 @@ from tensor2robot_tpu import config as gin
 from tensor2robot_tpu.data import prefetch as prefetch_lib
 from tensor2robot_tpu.hooks import Hook, HookList
 from tensor2robot_tpu.parallel import mesh as mesh_lib
+from tensor2robot_tpu.parallel import sharding as sharding_lib
 from tensor2robot_tpu.research.qtopt.qtopt_learner import (
     QTOptLearner,
     QTOptState,
@@ -50,6 +51,7 @@ def train_qtopt(
     prefill_random: bool = False,
     steps_per_dispatch: int = 1,
     prefetch_buffer_size: Optional[int] = None,
+    shard_weight_update: bool = False,
 ) -> QTOptState:
   """Runs the QT-Opt learner loop; resumes from model_dir checkpoints.
 
@@ -86,6 +88,13 @@ def train_qtopt(
   static/offline buffers — logged episodes, prefill_random — where
   sample timing is irrelevant; online runs should treat K as a
   throughput/off-policy-staleness trade-off, now a measured one.
+
+  `shard_weight_update=True` shards the optimizer step + moments over
+  the mesh's data axis (reduce-scatter grads / all-gather params —
+  `optimizers.shard_weight_update`, docs/PERF.md): each replica
+  updates 1/N of every weight instead of all replicas repeating the
+  full update. On a 1-device mesh it is a bitwise no-op (pinned);
+  checkpoints are unaffected (save gathers to host either way).
   """
   if mesh is None:
     mesh = mesh_lib.create_mesh()
@@ -110,10 +119,23 @@ def train_qtopt(
         seed=seed)
     replay_buffer.add(fill)
   rng = jax.random.PRNGKey(seed)
+  if shard_weight_update:
+    # Wrap BEFORE the state exists so tx is final when the step
+    # traces; init stays untouched (shardings come from placement).
+    from tensor2robot_tpu.models import optimizers as opt_lib
+    learner.model.wrap_optimizer(
+        lambda tx: opt_lib.shard_weight_update(tx, mesh))
   state = learner.create_state(rng, batch_size=2)
   repl = mesh_lib.replicated(mesh)
   data_sharding = mesh_lib.batch_sharding(mesh)
-  state = jax.device_put(state, repl)
+  # The carried-state sharding: fully replicated, or — under
+  # shard_weight_update — optimizer moments sharded over the data
+  # axis (they must STAY sharded across steps, so this pytree is used
+  # for placement and both jit sharding sides).
+  state_sharding = (
+      sharding_lib.train_state_update_sharding(mesh, state)
+      if shard_weight_update else repl)
+  state = jax.device_put(state, state_sharding)
   resume_step = ckpt_lib.latest_step(model_dir)
   if resume_step is not None:
     log.info("Resuming QT-Opt from step %d", resume_step)
@@ -138,14 +160,20 @@ def train_qtopt(
   hook_list.begin(learner.model, model_dir)
   replay_buffer.wait_until_size(min_replay_size or batch_size)
 
+  # int8 CEM tower: activation scales calibrate on a real held-out
+  # replay batch BEFORE the step is traced (the scales are trace-time
+  # constants; see QTOptLearner.calibrate / docs/PERF.md).
+  if getattr(learner, "needs_calibration", False):
+    learner.calibrate(state, replay_buffer.sample(batch_size))
+
   writer = ckpt_lib.CheckpointWriter(
       model_dir, max_to_keep=max_checkpoints_to_keep)
 
   if k == 1:
     train_step = jax.jit(
         learner.train_step,
-        in_shardings=(repl, data_sharding, repl),
-        out_shardings=(repl, repl),
+        in_shardings=(state_sharding, data_sharding, repl),
+        out_shardings=(state_sharding, repl),
         donate_argnums=(0,),
     )
     stream = replay_buffer.as_stream(batch_size)
@@ -158,8 +186,8 @@ def train_qtopt(
     stacked_sharding = prefetch_lib.stacked_sharding(data_sharding)
     train_step = jax.jit(
         k_steps,
-        in_shardings=(repl, stacked_sharding, repl, repl),
-        out_shardings=(repl, repl),
+        in_shardings=(state_sharding, stacked_sharding, repl, repl),
+        out_shardings=(state_sharding, repl),
         donate_argnums=(0,),
     )
     stream = prefetch_lib.stack_batches(

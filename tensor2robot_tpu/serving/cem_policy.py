@@ -50,6 +50,13 @@ class CEMPolicyServer:
         prevent). `warmup_seconds` records the cost.
     """
     self._learner = learner
+    # The serving CEM rides the learner's gin-selected perf levers
+    # (int8 tower / fused select — docs/PERF.md); an int8 learner that
+    # was never calibrated on real data gets spec-random calibration
+    # here, BEFORE the engine AOT-compiles the policy.
+    ensure = getattr(learner, "ensure_calibrated", None)
+    if ensure is not None:
+      ensure(state)
     policy = learner.build_policy(cem_population=cem_population,
                                   cem_iterations=cem_iterations)
     example = make_random_tensors(

@@ -173,6 +173,83 @@ class TestJaxRules:
     assert "JAX204" in _rules(
         run_jax_rules([str(tmp_path)], str(tmp_path)))
 
+  def test_pallas_kernel_is_device_code_not_host_sync(self, tmp_path):
+    """The Pallas carve-outs (ISSUE 7): pl.load/pl.store/ref indexing
+    and Python branches on static block params inside a kernel are
+    device code — zero findings, zero pragmas."""
+    _write(tmp_path, "mod.py", """
+        import functools
+        from jax.experimental import pallas as pl
+
+        def _kernel(x_ref, o_ref, *, block: int):
+          if block > 4:  # static block-param branch: the kernel idiom
+            val = pl.load(x_ref, (slice(None),))
+          else:
+            val = x_ref[...]
+          pl.store(o_ref, (slice(None),), val * 2)
+
+        def run(x):
+          kernel = functools.partial(_kernel, block=8)
+          return pl.pallas_call(kernel, out_shape=None)(x)
+    """)
+    assert _rules(run_jax_rules([str(tmp_path)], str(tmp_path))) == set()
+
+  def test_pallas_kernel_still_scanned_for_impurity(self, tmp_path):
+    """Pallas-aware ≠ pallas-blind: kernels ARE traced device code,
+    so a genuine hazard inside one (host clock) is still flagged —
+    through both the direct-name and the partial-variable entry."""
+    _write(tmp_path, "mod.py", """
+        import functools
+        import time
+        from jax.experimental import pallas as pl
+
+        def _bad_kernel(x_ref, o_ref):
+          time.sleep(0.1)
+          o_ref[...] = x_ref[...]
+
+        def run(x):
+          return pl.pallas_call(_bad_kernel, out_shape=None)(x)
+
+        def _bad_kernel2(x_ref, o_ref, *, n: int):
+          t = time.time()
+          o_ref[...] = x_ref[...] + t
+
+        def run2(x):
+          kernel = functools.partial(_bad_kernel2, n=4)
+          return pl.pallas_call(kernel, out_shape=None)(x)
+    """)
+    found = run_jax_rules([str(tmp_path)], str(tmp_path))
+    assert sum(f.rule == "JAX202" for f in found) == 2
+    assert {f.scope for f in found} == {"_bad_kernel", "_bad_kernel2"}
+
+  def test_pallas_partial_vars_resolve_per_scope(self, tmp_path):
+    """Two functions both naming their partial `kernel` must resolve
+    to their OWN kernels — a module-wide name map would let the
+    second shadow the first and miss its hazard."""
+    _write(tmp_path, "mod.py", """
+        import functools
+        import time
+        from jax.experimental import pallas as pl
+
+        def _hazard_kernel(x_ref, o_ref, *, n: int):
+          time.sleep(0.1)
+          o_ref[...] = x_ref[...]
+
+        def _clean_kernel(x_ref, o_ref, *, n: int):
+          o_ref[...] = x_ref[...]
+
+        def run_hazard(x):
+          kernel = functools.partial(_hazard_kernel, n=2)
+          return pl.pallas_call(kernel, out_shape=None)(x)
+
+        def run_clean(x):
+          kernel = functools.partial(_clean_kernel, n=2)
+          return pl.pallas_call(kernel, out_shape=None)(x)
+    """)
+    found = run_jax_rules([str(tmp_path)], str(tmp_path))
+    assert [f.rule for f in found] == ["JAX202"]
+    assert found[0].scope == "_hazard_kernel"
+
   def test_entry_detection_call_form_and_scan(self, tmp_path):
     # jax.jit(fn) / jax.lax.scan(body, ...) call forms, not decorators.
     _write(tmp_path, "mod.py", """
@@ -777,7 +854,7 @@ class TestGinValidation:
     )
     package = os.path.join(REPO_ROOT, "tensor2robot_tpu")
     configs = discover_configs([package])
-    assert len(configs) == 9, configs  # re-pin when shipping new ones
+    assert len(configs) == 10, configs  # re-pin when shipping new ones
     found = run_gin_rules([package], REPO_ROOT)
     assert found == [], [f.render() for f in found]
 

@@ -1,0 +1,253 @@
+"""The MFU levers: int8 CEM tower parity, sharded weight update pins,
+remat-policy exactness, and the train_qtopt wiring.
+
+Gates (ISSUE 7): the int8 tower must pass END-METRIC parity against
+bf16 (action agreement / value regret, not just tensor closeness); the
+sharded optimizer step must be BITWISE equal to the replicated one on
+a 1-device mesh (the constraint-only contract) and numerically equal
+across an 8-device mesh; remat recompute is exact arithmetic and must
+be bitwise.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tensor2robot_tpu import specs
+from tensor2robot_tpu.research.qtopt import GraspingQModel, QTOptLearner
+
+
+def _learner(cem_inference="bf16", cem_select="lax", dtype=jnp.float32,
+             **model_kwargs):
+  kwargs = dict(image_size=16, torso_filters=(8, 8),
+                head_filters=(8, 8), dense_sizes=(16,), action_dim=3,
+                device_dtype=dtype)
+  kwargs.update(model_kwargs)
+  model = GraspingQModel(**kwargs)
+  return QTOptLearner(model, cem_population=16, cem_iterations=2,
+                      cem_elites=4, cem_inference=cem_inference,
+                      cem_select=cem_select)
+
+
+def _batch(learner, batch_size=8, seed=0):
+  tr = specs.make_random_tensors(learner.transition_specification(),
+                                 batch_size=batch_size, seed=seed)
+  return jax.tree_util.tree_map(jnp.asarray, tr)
+
+
+class TestInt8TowerParity:
+  """int8 vs bf16 CEM tower: end-metric parity, not bit equality."""
+
+  def _pair(self):
+    base = _learner()
+    i8 = _learner(cem_inference="int8")
+    state = base.create_state(jax.random.PRNGKey(0), batch_size=2)
+    tr = _batch(base)
+    i8.calibrate(state, tr)
+    return base, i8, state, tr
+
+    # (scores are f32 models here so the only divergence IS the int8
+    # quantization — the property under test)
+
+  def test_score_parity(self):
+    """Quantized population scores track the exact ones."""
+    base, i8, state, tr = self._pair()
+    flat = {k: v for k, v in tr.to_flat_dict().items()
+            if not k.startswith("next_") and k not in ("reward",
+                                                       "done")}
+    feats = specs.TensorSpecStruct.from_flat_dict(flat)
+    variables = {"params": state.train_state.params,
+                 "batch_stats": state.train_state.batch_stats}
+    actions = jnp.asarray(
+        np.random.default_rng(3).uniform(-1, 1, (8, 16, 3)),
+        jnp.float32)
+    exact = jax.jit(base._cem_fns(variables, feats)[0])(actions)
+    quant = jax.jit(i8._cem_fns(variables, feats)[0])(actions)
+    err = np.max(np.abs(np.asarray(exact) - np.asarray(quant)))
+    spread = float(np.ptp(np.asarray(exact))) + 1e-6
+    assert err / spread < 0.05, (err, spread)
+
+  def test_action_value_regret(self):
+    """End-metric gate: actions the int8 CEM picks must be (near-)
+    optimal under the EXACT scorer — value regret, robust to ties."""
+    base, i8, state, tr = self._pair()
+    obs = specs.make_random_tensors(base.observation_specification(),
+                                    batch_size=8, seed=1)
+    obs = jax.tree_util.tree_map(jnp.asarray, obs)
+    rng = jax.random.PRNGKey(7)
+    a_exact = np.asarray(base.build_policy()(state, obs, rng))
+    a_quant = np.asarray(i8.build_policy()(state, obs, rng))
+
+    variables = {"params": state.train_state.params,
+                 "batch_stats": state.train_state.batch_stats}
+    score_fn = base._cem_fns(variables, obs)[0]
+    q_exact = np.asarray(score_fn(jnp.asarray(a_exact[:, None])))[:, 0]
+    q_quant = np.asarray(score_fn(jnp.asarray(a_quant[:, None])))[:, 0]
+    regret = q_exact - q_quant  # >0 where int8 picked a worse action
+    spread = float(np.ptp(q_exact)) + 1e-6
+    assert float(np.max(regret)) / spread < 0.05, (regret, spread)
+
+  def test_bellman_target_parity(self):
+    """The learner-level end metric: CEM Bellman targets agree."""
+    base, i8, state, tr = self._pair()
+    _, m_exact = jax.jit(base.train_step)(state, tr,
+                                          jax.random.PRNGKey(1))
+    _, m_quant = jax.jit(i8.train_step)(state, tr,
+                                        jax.random.PRNGKey(1))
+    np.testing.assert_allclose(float(m_quant["q_next_mean"]),
+                               float(m_exact["q_next_mean"]),
+                               atol=5e-3)
+    np.testing.assert_allclose(float(m_quant["target_mean"]),
+                               float(m_exact["target_mean"]),
+                               atol=5e-3)
+
+  def test_needs_calibration_contract(self):
+    i8 = _learner(cem_inference="int8")
+    state = i8.create_state(jax.random.PRNGKey(0), batch_size=2)
+    assert i8.needs_calibration
+    with pytest.raises(RuntimeError, match="calibrate"):
+      jax.jit(i8.train_step)(state, _batch(i8), jax.random.PRNGKey(1))
+    i8.ensure_calibrated(state.train_state)
+    assert not i8.needs_calibration
+    jax.jit(i8.train_step)(state, _batch(i8), jax.random.PRNGKey(1))
+
+  def test_fused_select_matches_lax_select_end_to_end(self):
+    """cem_select='fused' (the Pallas kernel through the select seam)
+    reproduces the default path's training metrics on an f32 model."""
+    base = _learner()
+    fused = _learner(cem_select="fused")
+    state = base.create_state(jax.random.PRNGKey(0), batch_size=2)
+    tr = _batch(base)
+    _, m0 = jax.jit(base.train_step)(state, tr, jax.random.PRNGKey(1))
+    _, m1 = jax.jit(fused.train_step)(state, tr, jax.random.PRNGKey(1))
+    np.testing.assert_allclose(float(m1["q_next_mean"]),
+                               float(m0["q_next_mean"]), atol=1e-5)
+    np.testing.assert_allclose(float(m1["loss"]), float(m0["loss"]),
+                               atol=1e-5)
+
+
+class TestShardedWeightUpdate:
+
+  def _jit_step(self, learner, mesh, sharded):
+    from tensor2robot_tpu.models import optimizers as opt_lib
+    from tensor2robot_tpu.parallel import (
+        batch_sharding,
+        replicated,
+        train_state_update_sharding,
+    )
+    if sharded:
+      learner.model.wrap_optimizer(
+          lambda tx: opt_lib.shard_weight_update(tx, mesh))
+    state = learner.create_state(jax.random.PRNGKey(0), batch_size=2)
+    repl = replicated(mesh)
+    state_sharding = (train_state_update_sharding(mesh, state)
+                      if sharded else repl)
+    state = jax.device_put(state, state_sharding)
+    step = jax.jit(learner.train_step,
+                   in_shardings=(state_sharding,
+                                 batch_sharding(mesh), repl),
+                   out_shardings=(state_sharding, repl))
+    return step, state
+
+  def test_one_device_mesh_bitwise(self):
+    """On a 1-device mesh every sharding constraint is a no-op: the
+    sharded step must be BITWISE identical to the replicated one."""
+    from tensor2robot_tpu.parallel import create_mesh
+    mesh = create_mesh({"data": 1}, devices=jax.devices()[:1])
+    tr = _batch(_learner())
+    rng = jax.random.PRNGKey(2)
+
+    results = []
+    for sharded in (False, True):
+      learner = _learner(dense_sizes=(128,))
+      step, state = self._jit_step(learner, mesh, sharded)
+      new_state, metrics = step(state, tr, rng)
+      results.append((jax.device_get(new_state), metrics))
+    (s0, m0), (s1, m1) = results
+    jax.tree_util.tree_map(np.testing.assert_array_equal,
+                           s0.train_state.params,
+                           s1.train_state.params)
+    jax.tree_util.tree_map(np.testing.assert_array_equal,
+                           s0.train_state.opt_state,
+                           s1.train_state.opt_state)
+    np.testing.assert_array_equal(np.asarray(m0["loss"]),
+                                  np.asarray(m1["loss"]))
+
+  def test_eight_device_mesh_shards_moments_and_matches(self):
+    """On the 8-device mesh the adam moments actually live sharded on
+    the data axis and the step matches the replicated math."""
+    from jax.sharding import PartitionSpec as P
+    from tensor2robot_tpu.parallel import DATA_AXIS, create_mesh
+    mesh = create_mesh({DATA_AXIS: 8})
+    tr = _batch(_learner())
+    rng = jax.random.PRNGKey(2)
+
+    learner_r = _learner(dense_sizes=(128,))
+    step_r, state_r = self._jit_step(learner_r, mesh, sharded=False)
+    ref, m_ref = step_r(state_r, tr, rng)
+
+    learner_s = _learner(dense_sizes=(128,))
+    step_s, state_s = self._jit_step(learner_s, mesh, sharded=True)
+    got, m_got = step_s(state_s, tr, rng)
+
+    # The q-head hidden kernel [16, 128] optimizer moments shard 128
+    # over the 8 data replicas (ZeRO contract, not just a no-op).
+    mu = None
+    for leaf in jax.tree_util.tree_leaves_with_path(
+        got.train_state.opt_state):
+      path, val = leaf
+      if "dense_0" in jax.tree_util.keystr(path) and val.ndim == 2 \
+          and val.shape[-1] == 128:
+        mu = val
+        break
+    assert mu is not None
+    assert mu.sharding.spec in (P(None, DATA_AXIS), P(None, "data")), \
+        mu.sharding
+    np.testing.assert_allclose(np.asarray(m_got["loss"]),
+                               np.asarray(m_ref["loss"]), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-5),
+        jax.device_get(got.train_state.params),
+        jax.device_get(ref.train_state.params))
+
+  def test_train_qtopt_shard_weight_update_smoke(self, tmp_path):
+    """The gin-level wiring: a short train_qtopt run with the flag on
+    completes and checkpoints on the default (1-device-per-axis) mesh."""
+    from tensor2robot_tpu.research.qtopt.train_qtopt import train_qtopt
+    learner = _learner()
+    state = train_qtopt(
+        learner=learner, model_dir=str(tmp_path), max_train_steps=2,
+        batch_size=8, save_checkpoints_steps=2, log_every_steps=2,
+        prefill_random=True, seed=0, shard_weight_update=True)
+    assert int(np.asarray(jax.device_get(state.step))) == 2
+
+
+class TestRematPolicy:
+
+  @pytest.mark.parametrize("policy", ["full", "dots", "dots_no_batch"])
+  def test_bitwise_equal_to_no_remat(self, policy):
+    """Remat recompute is exact arithmetic: every policy must produce
+    bitwise-identical params/metrics, only the memory schedule moves."""
+    tr = _batch(_learner())
+    rng = jax.random.PRNGKey(3)
+    outs = []
+    for p in (None, policy):
+      learner = _learner(remat_policy=p)
+      state = learner.create_state(jax.random.PRNGKey(0), batch_size=2)
+      new_state, metrics = jax.jit(learner.train_step)(state, tr, rng)
+      outs.append((jax.device_get(new_state.train_state.params),
+                   jax.device_get(metrics)))
+    (p0, m0), (p1, m1) = outs
+    jax.tree_util.tree_map(np.testing.assert_array_equal, p0, p1)
+    np.testing.assert_array_equal(np.asarray(m0["loss"]),
+                                  np.asarray(m1["loss"]))
+
+  def test_unknown_policy_raises(self):
+    learner = _learner(remat_policy="everything")
+    state = learner.create_state(jax.random.PRNGKey(0), batch_size=2)
+    with pytest.raises(ValueError, match="remat_policy"):
+      jax.jit(learner.train_step)(state, _batch(learner),
+                                  jax.random.PRNGKey(1))
