@@ -96,6 +96,17 @@ Usage: python bench.py [--paper] [--profile DIR] [--input] [--replay]
              With --dry-run: tiny mock-model trainer probes on the
              local backend, no BENCH_DETAIL.json write — the tier-1
              smoke of the coldstart bench path itself.
+  --fleet    the learner/actor FLEET axis (fleet section): a real
+             multi-process Podracer run on this host — ≥2 jax-free
+             actor processes (GraspActor → MuJoCoPoseEnv via the
+             PoseGraspBandit adapter) + one replay/serving host +
+             one learner process, supervised by the fleet
+             orchestrator with the --validate_only launch gate.
+             Commits env_steps_per_sec, learner_steps_per_sec, the
+             param_refresh_lag distribution, and the replay
+             staleness the learner actually trained on. With
+             --dry-run: tiny model, short run, no BENCH_DETAIL.json
+             write — the tier-1 smoke.
   --serving  the low-latency serving axis (serving_latency section):
              CEM action-selection latency at batch=1 and batch=8
              through the bucketed AOT engine (p50/p95 over ≥100
@@ -1584,6 +1595,104 @@ def _run_coldstart_probe(kind: str, model_dir: str,
   return result
 
 
+def bench_fleet(dry_run: bool = False):
+  """The --fleet axis: a REAL multi-process Podracer run on this host.
+
+  Topology (docs/FLEET.md): 2 jax-free actor processes (GraspActor
+  driving MuJoCoPoseEnv through the PoseGraspBandit adapter) pull
+  actions from, and commit atomic episodes into, one replay/serving
+  host process (CEMPolicyServer + ReplayWriteService/ReplayStore); a
+  learner process runs train_qtopt on the host's store and publishes
+  each checkpoint's params back into the serving engine, stamped with
+  the learner step. The orchestrator supervises all of it, and the
+  shipped qtopt_fleet.gin rides through `run_t2r_trainer
+  --validate_only` as the pre-spawn launch gate, so the gate path is
+  exercised on every bench run.
+
+  Measured end-to-end (not per-organ): committed env transitions/s
+  over the commit window, learner grad-steps/s over the learner-step
+  window, the param_refresh_lag distribution (learner step at commit
+  minus at the publication the actor acted with), and the replay
+  staleness histogram of the batches the learner actually trained on.
+  `dry_run`: tiny model/short run, NO detail-file write — the tier-1
+  smoke. The real run uses a BENCH-tuned FleetConfig: the shipped
+  qtopt_fleet.gin's model/topology scale, but a shorter run
+  (240 steps, 40-step cadence vs the config's 500/50) so the axis
+  fits a bench budget — the shipped file itself is exercised as the
+  launch gate, not as the measured config.
+  """
+  import shutil
+  import tempfile
+
+  from tensor2robot_tpu.fleet import Fleet, FleetConfig
+
+  tiny = dry_run
+  config = FleetConfig(
+      num_actors=2,
+      env="mujoco_pose",
+      image_size=16 if tiny else 32,
+      action_dim=2,
+      torso_filters=(8,) if tiny else (16, 32),
+      head_filters=(8,) if tiny else (32, 32),
+      dense_sizes=(16,) if tiny else (32, 32),
+      cem_population=8 if tiny else 64,
+      cem_iterations=1 if tiny else 2,
+      cem_elites=2 if tiny else 6,
+      batch_size=16 if tiny else 64,
+      max_train_steps=24 if tiny else 240,
+      min_replay_size=32 if tiny else 128,
+      publish_every_steps=8 if tiny else 40,
+      log_every_steps=8 if tiny else 40,
+      batch_episodes=8 if tiny else 16,
+      serve_max_batch=4 if tiny else 8,
+      replay_capacity=512 if tiny else 4096,
+      replay_shards=2,
+      heartbeat_timeout_secs=0.0 if tiny else 300.0,
+      launch_timeout_secs=240.0,
+      run_timeout_secs=600.0 if tiny else 1500.0,
+      seed=0)
+  gate_config = os.path.join(
+      os.path.dirname(os.path.abspath(__file__)), "tensor2robot_tpu",
+      "research", "qtopt", "configs", "qtopt_fleet.gin")
+  model_dir = tempfile.mkdtemp(prefix="t2r_fleet_bench_")
+  try:
+    fleet = Fleet(config, model_dir, gin_configs=(gate_config,))
+    result = fleet.run()
+  finally:
+    shutil.rmtree(model_dir, ignore_errors=True)
+  staleness = {
+      batch: {k: snap[k] for k in ("mean_age_steps", "max_age_steps",
+                                   "batch_mean_age_p95_steps", "rows")}
+      for batch, snap in result.replay_staleness.items()
+      if snap}
+  service = result.metrics.get("service", {})
+  return {
+      "device_kind": jax.devices()[0].device_kind,
+      "host_cores": os.cpu_count(),
+      "num_actors": config.num_actors,
+      "env": config.env,
+      "launch_gate": "run_t2r_trainer --validate_only (passed)",
+      "env_steps_per_sec": round(result.env_steps_per_sec, 1),
+      "learner_steps_per_sec": round(result.learner_steps_per_sec, 2),
+      "param_refresh_lag": result.param_refresh_lag,
+      "replay_staleness": staleness,
+      "publishes": result.publishes,
+      "params_version": result.params_version,
+      "actor_restarts": result.actor_restarts,
+      "dropped_batches": service.get("replay_dropped_batches"),
+      "committed_transitions": service.get(
+          "replay_committed_transitions"),
+      "wall_secs": round(result.wall_secs, 1),
+      "clean_shutdown": result.clean_shutdown,
+      "note": (
+          "real multi-process run on this host: every organ crossed a "
+          "process boundary (actions via the host's micro-batched AOT "
+          "engine, episodes via atomic replay sessions, params via "
+          "learner-step-stamped hot-swap publications); lag/staleness "
+          "are in learner steps"),
+  }
+
+
 def bench_coldstart(dry_run: bool = False):
   """The restart-latency axis: cold-cache vs warm-cache subprocesses.
 
@@ -2120,6 +2229,21 @@ def main():
         "analytic_vs_xla_flops": smoke["analytic_vs_xla_flops"],
     }))
     return
+  if "--fleet" in args and "--dry-run" in args:
+    # Tier-1 smoke of the fleet path: a REAL (tiny) multi-process run
+    # — 2 actors + host + learner through the launch gate — NO
+    # detail-file write.
+    smoke = bench_fleet(dry_run=True)
+    print(json.dumps({
+        "fleet_dry_run": "ok",
+        "num_actors": smoke["num_actors"],
+        "env_steps_per_sec": smoke["env_steps_per_sec"],
+        "learner_steps_per_sec": smoke["learner_steps_per_sec"],
+        "publishes": smoke["publishes"],
+        "param_refresh_lag_rows": smoke["param_refresh_lag"]["rows"],
+        "clean_shutdown": smoke["clean_shutdown"],
+    }))
+    return
   if "--serving" in args and "--dry-run" in args:
     # Tier-1 smoke of the serving bench path: tiny model, one small
     # bucket table, local backend, NO detail-file write (a CPU smoke
@@ -2174,7 +2298,8 @@ def main():
   detail["version"] = 3  # schema: + first-class analytic mfu
   axis_flags = {"--input", "--replay", "--replayfeed", "--longcontext",
                 "--podscale", "--moe", "--pipeline", "--verify",
-                "--serving", "--coldstart", "--mxu", "--mfu"}
+                "--serving", "--coldstart", "--mxu", "--mfu",
+                "--fleet"}
   axis_only = (bool(args) and not run_paper and profile_dir is None
                and "--primary" not in args
                and all(a in axis_flags for a in args))
@@ -2259,6 +2384,8 @@ def main():
     detail["hardware_numerics"] = bench_verify_numerics()
   if "--serving" in args:
     detail["serving_latency"] = bench_serving()
+  if "--fleet" in args:
+    detail["fleet"] = bench_fleet()
   if "--coldstart" in args:
     detail["coldstart"] = bench_coldstart()
   if "--mfu" in args:

@@ -39,9 +39,14 @@ echo "--- mfu bench smoke (bench.py --mfu --dry-run) ---"
 env JAX_PLATFORMS=cpu python bench.py --mfu --dry-run
 mfu_rc=$?
 
+echo "--- fleet bench smoke (bench.py --fleet --dry-run) ---"
+env JAX_PLATFORMS=cpu python bench.py --fleet --dry-run
+fleet_rc=$?
+
 if [ "$rc" -ne 0 ]; then exit "$rc"; fi
 if [ "$smoke_rc" -ne 0 ]; then exit "$smoke_rc"; fi
 if [ "$coldstart_rc" -ne 0 ]; then exit "$coldstart_rc"; fi
 if [ "$replay_rc" -ne 0 ]; then exit "$replay_rc"; fi
 if [ "$input_rc" -ne 0 ]; then exit "$input_rc"; fi
-exit "$mfu_rc"
+if [ "$mfu_rc" -ne 0 ]; then exit "$mfu_rc"; fi
+exit "$fleet_rc"

@@ -29,7 +29,9 @@ _PACKAGE_DIR = os.path.dirname(os.path.dirname(os.path.abspath(
 REPO_ROOT = os.path.dirname(_PACKAGE_DIR)
 
 # Default scan scope per family. The concurrency family covers the
-# four subsystems the lock-order graph is specified over (ISSUE 5);
+# five subsystems the lock-order graph is specified over (ISSUE 5;
+# fleet added by ISSUE 8 — the orchestrator's process/thread
+# lifecycle lands with zero pragmas, baseline stays empty);
 # jax covers the whole package (traced code lives everywhere: models,
 # ops, parallel, research).
 _JAX_PATHS = ("tensor2robot_tpu",)
@@ -38,6 +40,7 @@ _CONCURRENCY_PATHS = (
     "tensor2robot_tpu/serving",
     "tensor2robot_tpu/data",
     "tensor2robot_tpu/startup",
+    "tensor2robot_tpu/fleet",
 )
 _GIN_PATHS = ("tensor2robot_tpu",)
 

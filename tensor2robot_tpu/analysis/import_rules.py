@@ -24,13 +24,21 @@ from tensor2robot_tpu.analysis.astutil import parse_module
 from tensor2robot_tpu.analysis.findings import Finding
 
 # Modules that must stay importable without jax/tensorflow. Spawn-path
-# closure of the data-plane worker: the plane module itself, the ring,
-# and the config engine the plane imports for @gin.configurable.
+# closure of the data-plane worker (the plane module itself, the ring,
+# and the config engine the plane imports for @gin.configurable) plus
+# the fleet ACTOR process closure (ISSUE 8: Podracer actors are cheap
+# — env stepping + RPC, never an XLA runtime; the dynamic twin of this
+# pin is tests/test_fleet.py's subprocess import check).
 WORKER_SAFE_MODULES = (
     "tensor2robot_tpu.data.plane",
     "tensor2robot_tpu.data.shm_ring",
     "tensor2robot_tpu.config",
     "tensor2robot_tpu.config.ginlite",
+    "tensor2robot_tpu.fleet.rpc",
+    "tensor2robot_tpu.fleet.proc",
+    "tensor2robot_tpu.fleet.actor",
+    "tensor2robot_tpu.research.qtopt.actor",
+    "tensor2robot_tpu.research.pose_env.grasp_bandit",
 )
 
 BANNED_IMPORTS = ("jax", "tensorflow")

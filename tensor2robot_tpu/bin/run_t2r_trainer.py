@@ -50,11 +50,14 @@ flags.DEFINE_bool(
     "Force jax.distributed.initialize() even without an explicit "
     "coordinator (TPU pod auto-discovery).")
 flags.DEFINE_enum(
-    "trainer", "train_eval", ["train_eval", "qtopt"],
+    "trainer", "train_eval", ["train_eval", "qtopt", "fleet"],
     "Entry to run after gin parsing: the supervised "
-    "train_eval_model() loop (default) or the QT-Opt learner loop "
+    "train_eval_model() loop (default), the QT-Opt learner loop "
     "(train_qtopt — configs binding train_qtopt.*, e.g. "
-    "research/qtopt/configs/qtopt_int8.gin).")
+    "research/qtopt/configs/qtopt_int8.gin), or the multi-process "
+    "learner/actor fleet (run_fleet — configs binding run_fleet.* / "
+    "FleetConfig.*, e.g. research/qtopt/configs/qtopt_fleet.gin; "
+    "docs/FLEET.md).")
 
 # Configurable registration happens at import; pull in every in-tree
 # family so configs can reference them without import lines.
@@ -66,6 +69,7 @@ _DEFAULT_MODULES = (
     "tensor2robot_tpu.predictors",
     "tensor2robot_tpu.hooks",
     "tensor2robot_tpu.meta_learning",
+    "tensor2robot_tpu.fleet",
     "tensor2robot_tpu.research.grasp2vec",
     "tensor2robot_tpu.research.pose_env",
     "tensor2robot_tpu.research.qtopt",
@@ -112,6 +116,11 @@ def main(argv):
   if FLAGS.trainer == "qtopt":
     from tensor2robot_tpu.research.qtopt.train_qtopt import train_qtopt
     train_qtopt()
+  elif FLAGS.trainer == "fleet":
+    # The orchestrator re-runs these configs through --validate_only
+    # as its pre-spawn launch gate (docs/FLEET.md).
+    from tensor2robot_tpu.fleet import run_fleet
+    run_fleet(gin_configs=configs)
   else:
     train_eval.train_eval_model()
 

@@ -1,0 +1,197 @@
+"""Fleet actor process: env stepping only — no jax, no device, no model.
+
+The Podracer actor is deliberately cheap: it steps environments and
+speaks RPC. Action selection happens in the host's serving engine
+(every actor's requests coalesce in the micro-batcher there), episode
+commits go through the host's replay sessions, and parameters never
+touch this process at all — so an actor costs a Python interpreter +
+an env, and `import jax` (seconds of spin-up, an XLA runtime of
+memory) never runs here. tests/test_fleet.py pins the jax-free import.
+
+The in-process building blocks are reused, not forked: the loop IS
+`GraspActor.collect_once` — this module just supplies its two seams
+with RPC-backed implementations:
+
+  * `FleetPolicyClient` — the `policy_server=` seam. Each `act` reply
+    carries the engine's params version + the learner step those
+    params were published at, so every episode is stamped with the
+    policy that produced it (the `param_refresh_lag` measurement
+    seam).
+  * `FleetReplaySession` — the replay-sink seam. One `add` = one
+    atomic episode commit server-side; the drop-policy bool comes
+    back so the actor's `episodes_dropped` accounting keeps working.
+    `begin/append/end` are exposed too (multi-chunk episodes, crash
+    injection): rows staged server-side between `begin` and `end` are
+    aborted if the connection dies — the mid-episode crash contract.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from tensor2robot_tpu.fleet import proc
+from tensor2robot_tpu.fleet.rpc import RpcClient
+
+log = logging.getLogger(__name__)
+
+# Exit code for injected hard crashes (tests/bench assert on it being
+# distinguishable from a clean 0 and a Python-exception 1).
+CRASH_EXIT_CODE = 13
+
+
+class FleetPolicyClient:
+  """`GraspActor.policy_server`-shaped proxy to the host's CEM server."""
+
+  def __init__(self, client: RpcClient, max_batch: int):
+    self._client = client
+    self.max_batch = int(max_batch)
+    self.params_version = 0
+    self.params_learner_step = 0
+
+  @property
+  def engine(self) -> "FleetPolicyClient":
+    # GraspActor chunks requests to `policy_server.engine.max_batch`;
+    # the remote engine's bucket table is what bounds us, so this
+    # proxy doubles as its own `engine`.
+    return self
+
+  def select_actions(self,
+                     observations: Dict[str, Any]) -> np.ndarray:
+    reply = self._client.call(
+        "act", {k: np.asarray(v) for k, v in observations.items()})
+    self.params_version = int(reply["params_version"])
+    self.params_learner_step = int(reply["params_learner_step"])
+    return np.asarray(reply["actions"])
+
+  def update_state(self, state) -> None:
+    raise NotImplementedError(
+        "fleet actors never push params; the learner publishes to the "
+        "host's engine directly")
+
+
+class FleetReplaySession:
+  """`GraspActor` replay sink committing through the host's sessions.
+
+  Every call stamps the episode with the policy version/learner-step
+  the paired `FleetPolicyClient` last acted with, which is how the
+  host attributes `param_refresh_lag` to committed rows.
+  """
+
+  def __init__(self, client: RpcClient, actor_id: str,
+               policy: Optional[FleetPolicyClient] = None):
+    self._client = client
+    self._policy = policy
+    self.actor_id = actor_id
+    self.last_transitions: Optional[Dict[str, np.ndarray]] = None
+
+  def _stamp(self) -> Dict[str, Any]:
+    if self._policy is None:
+      return {"policy_version": None, "policy_learner_step": None}
+    return {"policy_version": self._policy.params_version,
+            "policy_learner_step": self._policy.params_learner_step}
+
+  def add(self, transitions: Dict[str, Any]) -> bool:
+    flat = {k: np.asarray(v) for k, v in transitions.items()}
+    self.last_transitions = flat
+    payload = {"actor_id": self.actor_id, "transitions": flat}
+    payload.update(self._stamp())
+    return bool(self._client.call("commit", payload))
+
+  def begin_episode(self) -> None:
+    self._client.call("begin_episode", self.actor_id)
+
+  def append(self, transitions: Dict[str, Any]) -> None:
+    self._client.call("append", {
+        "actor_id": self.actor_id,
+        "transitions": {k: np.asarray(v)
+                        for k, v in transitions.items()}})
+
+  def end_episode(self) -> bool:
+    payload = {"actor_id": self.actor_id}
+    payload.update(self._stamp())
+    return bool(self._client.call("end_episode", payload))
+
+
+def build_env(config, actor_index: int):
+  """The per-actor environment, seeded per index.
+
+  `mujoco_pose` is the fleet default: `GraspActor` driving the
+  physics-backed `MuJoCoPoseEnv` through the `PoseGraspBandit`
+  adapter. `pose` is the numpy variant (no mujoco dependency);
+  `toy_grasp` is the original QT-Opt bandit.
+  """
+  seed = config.seed + 1009 * (actor_index + 1)
+  if config.env == "toy_grasp":
+    from tensor2robot_tpu.research.qtopt.grasping_env import ToyGraspEnv
+    return ToyGraspEnv(image_size=config.image_size,
+                       action_dim=config.action_dim, seed=seed)
+  if config.env in ("pose", "mujoco_pose"):
+    from tensor2robot_tpu.research.pose_env.grasp_bandit import (
+        PoseGraspBandit,
+    )
+    return PoseGraspBandit(image_size=config.image_size,
+                           action_dim=config.action_dim,
+                           physics=(config.env == "mujoco_pose"),
+                           seed=seed)
+  raise ValueError(f"unknown fleet env {config.env!r}")
+
+
+def _inject_crash(mode: str, sink: FleetReplaySession) -> None:
+  """Test/bench fault injection (FleetConfig.actor_crash_*)."""
+  if mode == "mid_episode":
+    # Die BETWEEN append and end_episode: rows are staged in the
+    # host-side session when the process vanishes. The disconnect
+    # abort (host.py) must discard them — the partial-episode pin.
+    sink.begin_episode()
+    if sink.last_transitions is not None:
+      sink.append(sink.last_transitions)
+    os._exit(CRASH_EXIT_CODE)
+  if mode == "hard":
+    os._exit(CRASH_EXIT_CODE)
+  raise RuntimeError("injected actor crash (FleetConfig.actor_crash_*)")
+
+
+def actor_main(config, actor_index: int, address, stop_event,
+               heartbeat, incarnation: int = 0) -> None:
+  """Child-process entry: connect → collect until told to stop."""
+  proc.scrub_inherited_distributed_env()
+  actor_id = f"actor-{actor_index}"
+  client = RpcClient(tuple(address), authkey=config.authkey)
+  try:
+    hello = client.call("hello")
+    policy = FleetPolicyClient(client, max_batch=hello["max_batch"])
+    sink = FleetReplaySession(client, actor_id, policy)
+    env = build_env(config, actor_index)
+
+    from tensor2robot_tpu.research.qtopt.actor import GraspActor
+
+    actor = GraspActor(
+        learner=None,
+        replay_buffer=sink,
+        env=env,
+        batch_episodes=config.batch_episodes,
+        epsilon=config.epsilon,
+        seed=config.seed + 101 * (actor_index + 1),
+        policy_server=policy,
+        name=actor_id)
+    crash_after = (
+        config.actor_crash_after_episodes
+        if (actor_index == config.crash_actor_index and incarnation == 0)
+        else None)
+    batches = 0
+    while not stop_event.is_set():
+      actor.collect_once()
+      batches += 1
+      proc.beat(heartbeat)
+      if crash_after is not None and batches >= crash_after:
+        _inject_crash(config.actor_crash_mode, sink)
+    log.info("actor %s stopping cleanly: %d committed / %d dropped "
+             "episodes, last policy version %s", actor_id,
+             actor.episodes_collected, actor.episodes_dropped,
+             actor.last_policy_version)
+  finally:
+    client.close()

@@ -1,0 +1,348 @@
+"""Fleet replay/serving host: one process owning the store + the engine.
+
+The Sebulba topology (PAPERS.md Podracer): actors do not touch the
+device or the replay memory — they speak RPC to ONE host process that
+owns both the `ReplayWriteService`→`ReplayStore` ingestion plane and
+the `CEMPolicyServer` (bucketed AOT engine + micro-batcher). Putting
+inference and replay in the same process is deliberate:
+
+  * every actor's `act` request lands in the SAME micro-batcher, so N
+    actors coalesce into ~one CEM program dispatch (the serving stack's
+    whole point, now fed by a process fleet instead of threads);
+  * the learner's `publish` hot-swaps the engine's params in the same
+    address space the actors' requests resolve against — one swap
+    serves the entire actor fleet atomically;
+  * `param_refresh_lag` and replay staleness are measured at the one
+    choke point every transition passes through.
+
+Metric definitions (docs/FLEET.md):
+
+  * `param_refresh_lag` — at each committed episode, the learner's
+    CURRENT step (the store's `learner_step` tag) minus the learner
+    step stamped on the params the actor acted with. This is the
+    end-to-end publication latency actors actually experience:
+    checkpoint cadence + publish transfer + however long the episode
+    took to collect.
+  * replay staleness — the plane's existing definition (learner step
+    at SAMPLE minus at ADD), accounted by the host-side
+    `ReplayBatchSampler` every learner `sample` rides through.
+
+Crash contract: each connection's replay sessions are aborted on
+disconnect (`rpc.DISCONNECT_METHOD`), so an actor that dies mid-episode
+never lands partial rows — same session-abort semantics as the
+in-process service, proven across the process boundary by
+tests/test_fleet.py.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from tensor2robot_tpu.fleet import proc
+from tensor2robot_tpu.fleet import rpc as rpc_lib
+
+log = logging.getLogger(__name__)
+
+# Lag histogram bucket upper bounds, in learner steps (same labelling
+# scheme as the replay plane's staleness histogram).
+LAG_BUCKETS = (0, 1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 4096)
+
+
+class _LagStats:
+  """Thread-safe accumulator for the param-refresh-lag distribution."""
+
+  def __init__(self):
+    self._lock = threading.Lock()
+    self._counts = np.zeros(len(LAG_BUCKETS) + 1, np.int64)
+    self._sum = 0
+    self._max = 0
+    self._n = 0
+
+  def record(self, lag: int, rows: int) -> None:
+    lag = max(int(lag), 0)
+    bucket = int(np.searchsorted(LAG_BUCKETS, lag, side="left"))
+    with self._lock:
+      self._counts[bucket] += rows
+      self._sum += lag * rows
+      self._max = max(self._max, lag)
+      self._n += rows
+
+  def snapshot(self) -> Dict[str, Any]:
+    with self._lock:
+      labels = [f"<={b}" for b in LAG_BUCKETS] + [f">{LAG_BUCKETS[-1]}"]
+      return {
+          "rows": int(self._n),
+          "mean": (self._sum / self._n) if self._n else 0.0,
+          "max": int(self._max),
+          "histogram": {label: int(count)
+                        for label, count in zip(labels, self._counts)},
+      }
+
+
+class _HostState:
+  """Everything the host serves, plus the RPC method table."""
+
+  def __init__(self, config):
+    # jax and the model stack load HERE, in the host process — never
+    # at module import (actor processes import this package jax-free).
+    import jax
+
+    from tensor2robot_tpu.replay.sampler import ReplayBatchSampler
+    from tensor2robot_tpu.replay.service import ReplayWriteService
+    from tensor2robot_tpu.replay.store import ReplayStore
+    from tensor2robot_tpu.serving.cem_policy import CEMPolicyServer
+
+    self._config = config
+    self._learner = _build_learner(config)
+    state0 = self._learner.create_state(
+        jax.random.PRNGKey(config.seed), batch_size=2)
+    acting0 = state0.train_state.replace(opt_state=None)
+    self.policy_server = CEMPolicyServer(
+        self._learner, acting0,
+        max_batch=config.serve_max_batch,
+        max_wait_us=config.serve_max_wait_us,
+        seed=config.seed + 7)
+    self.store = ReplayStore(
+        self._learner.transition_specification(),
+        capacity=config.replay_capacity,
+        num_shards=config.replay_shards,
+        seed=config.seed + 11)
+    self.service = ReplayWriteService(
+        self.store,
+        queue_batches=config.queue_batches,
+        overflow=config.overflow)
+    self._sampler_cls = ReplayBatchSampler
+    self._samplers: Dict[int, Any] = {}
+    self._sessions: Dict[str, Any] = {}
+    self._lock = threading.Lock()
+    self.lag = _LagStats()
+    self.publishes = 0
+    self._publish_t0: Optional[float] = None
+    self._learner_window: Optional[Tuple[float, int, float, int]] = None
+    self._commit_window: Optional[Tuple[float, float]] = None
+    self.shutdown_requested = threading.Event()
+
+  # ---- wiring helpers ----
+
+  def _session_for(self, actor_id: str, ctx: dict):
+    with self._lock:
+      session = self._sessions.get(actor_id)
+    if session is None or session.closed:
+      # A fresh claim under an existing actor_id is the restart path:
+      # `service.session` counts it and aborts whatever the dead
+      # incarnation staged (restart-with-session-abort).
+      session = self.service.session(actor_id)
+      with self._lock:
+        self._sessions[actor_id] = session
+    # Track the OBJECT this connection used, not just the id: a
+    # hard-killed actor's connection can be detected dead AFTER its
+    # replacement re-registered, and the late disconnect must abort
+    # the old incarnation's session, never the new one's.
+    ctx.setdefault("sessions", {})[actor_id] = session
+    return session
+
+  def _sampler(self, batch_size: int):
+    with self._lock:
+      sampler = self._samplers.get(batch_size)
+      if sampler is None:
+        sampler = self._sampler_cls(self.store, batch_size)
+        self._samplers[batch_size] = sampler
+    return sampler
+
+  def _record_commit(self, rows: int, policy_learner_step) -> None:
+    now = time.monotonic()
+    with self._lock:
+      first = self._commit_window[0] if self._commit_window else now
+      self._commit_window = (first, now)
+    if policy_learner_step is not None:
+      self.lag.record(self.store.learner_step - int(policy_learner_step),
+                      rows)
+
+  # ---- the RPC method table ----
+
+  def handle(self, method: str, payload: Any, ctx: dict) -> Any:
+    if method == "act":
+      # One atomic publication read: version and learner_step must be
+      # a consistent pair (a swap between two property reads would
+      # tear them). A swap landing between this read and the engine's
+      # own dispatch can still attribute a single episode to the
+      # adjacent publication — off by at most one refresh, which the
+      # lag histogram tolerates (documented in docs/FLEET.md).
+      publication = self.policy_server.engine.publication
+      actions = self.policy_server.select_actions(payload)
+      return {"actions": np.asarray(actions),
+              "params_version": publication.version,
+              "params_learner_step": publication.learner_step}
+    if method == "commit":
+      session = self._session_for(payload["actor_id"], ctx)
+      accepted = session.add(payload["transitions"])
+      if accepted:
+        rows = int(next(iter(payload["transitions"].values())).shape[0])
+        self._record_commit(rows, payload.get("policy_learner_step"))
+      return bool(accepted)
+    if method == "begin_episode":
+      self._session_for(payload, ctx).begin_episode()
+      return True
+    if method == "append":
+      self._session_for(payload["actor_id"], ctx).append(
+          payload["transitions"])
+      return True
+    if method == "end_episode":
+      session = self._session_for(payload["actor_id"], ctx)
+      committed_before = session.transitions_committed
+      accepted = session.end_episode()
+      if accepted:
+        self._record_commit(
+            session.transitions_committed - committed_before,
+            payload.get("policy_learner_step"))
+      return bool(accepted)
+    if method == "sample":
+      batch = self._sampler(int(payload)).sample()
+      return {k: np.asarray(v)
+              for k, v in batch.to_flat_dict().items()}
+    if method == "size":
+      return len(self.store)
+    if method == "set_learner_step":
+      step = int(payload)
+      self.store.set_learner_step(step)
+      now = time.monotonic()
+      with self._lock:
+        if self._learner_window is None:
+          self._learner_window = (now, step, now, step)
+        else:
+          t0, s0, _, _ = self._learner_window
+          self._learner_window = (t0, s0, now, step)
+      return True
+    if method == "publish":
+      self.policy_server.update_state(
+          payload["state"], learner_step=int(payload["step"]))
+      with self._lock:
+        self.publishes += 1
+        if self._publish_t0 is None:
+          self._publish_t0 = time.monotonic()
+      return self.policy_server.params_version
+    if method == "metrics_scalars":
+      out = self.store.metrics_scalars()
+      with self._lock:
+        samplers = list(self._samplers.values())
+      for sampler in samplers:
+        out.update(sampler.metrics_scalars())
+      out["fleet_param_publishes"] = float(self.publishes)
+      out["fleet_param_refresh_lag_mean"] = self.lag.snapshot()["mean"]
+      return out
+    if method == "metrics":
+      return self.metrics()
+    if method == "hello":
+      engine = self.policy_server.engine
+      return {"max_batch": engine.max_batch,
+              "capacity": self.store.capacity,
+              "params_version": engine.params_version,
+              "params_learner_step": engine.params_learner_step}
+    if method == "shutdown":
+      self.shutdown_requested.set()
+      return True
+    if method == rpc_lib.DISCONNECT_METHOD:
+      # A dropped connection aborts every session IT opened: whatever
+      # its actor staged mid-episode is discarded, never committed. The
+      # identity check keeps a late-detected death from touching a
+      # restarted incarnation's fresh session.
+      for actor_id, session in ctx.get("sessions", {}).items():
+        if not session.closed:
+          session.abort()
+        with self._lock:
+          if self._sessions.get(actor_id) is session:
+            del self._sessions[actor_id]
+      return None
+    raise ValueError(f"unknown fleet rpc method {method!r}")
+
+  def metrics(self) -> Dict[str, Any]:
+    with self._lock:
+      learner_window = self._learner_window
+      commit_window = self._commit_window
+      samplers = list(self._samplers.items())
+      publishes = self.publishes
+    staleness: Dict[str, Any] = {}
+    for batch_size, sampler in samplers:
+      staleness[str(batch_size)] = sampler.staleness_snapshot()
+    engine = self.policy_server.engine
+    return {
+        "store": self.store.metrics_snapshot(),
+        "service": self.service.metrics_scalars(),
+        "staleness": staleness,
+        "param_refresh_lag": self.lag.snapshot(),
+        "publishes": publishes,
+        "params_version": engine.params_version,
+        "params_learner_step": engine.params_learner_step,
+        "learner_window": (None if learner_window is None else {
+            "first_time": learner_window[0],
+            "first_step": learner_window[1],
+            "last_time": learner_window[2],
+            "last_step": learner_window[3],
+        }),
+        "commit_window": (None if commit_window is None else {
+            "first_time": commit_window[0],
+            "last_time": commit_window[1],
+        }),
+        "serving_dispatches": engine.dispatch_count,
+    }
+
+  def close(self) -> None:
+    # Intake is already stopped (the RPC server closes first); flush
+    # what the writer still holds, then tear the batcher down.
+    try:
+      self.service.close()
+    finally:
+      self.policy_server.close()
+
+
+def _build_learner(config):
+  """The host's own QTOptLearner: the same constructor the learner
+  process uses, so the published TrainState trees match structurally
+  (CEM serving params here, gradient state there)."""
+  from tensor2robot_tpu.research.qtopt.qtopt_learner import QTOptLearner
+  from tensor2robot_tpu.research.qtopt.t2r_models import GraspingQModel
+
+  model = GraspingQModel(
+      image_size=config.image_size,
+      action_dim=config.action_dim,
+      torso_filters=tuple(config.torso_filters),
+      head_filters=tuple(config.head_filters),
+      dense_sizes=tuple(config.dense_sizes))
+  return QTOptLearner(
+      model,
+      cem_population=config.cem_population,
+      cem_iterations=config.cem_iterations,
+      cem_elites=config.cem_elites,
+      cem_inference=config.cem_inference)
+
+
+def host_main(config, ready_conn, stop_event, heartbeat) -> None:
+  """Child-process entry: build → handshake → serve → drain → exit.
+
+  `ready_conn` (a Pipe end) carries the bound RPC address back to the
+  orchestrator once the engine is warmed; the orchestrator spawns
+  actors/learner only after this handshake, so clients never race a
+  cold host.
+
+  `stop_event` is the host's OWN stop signal, set by the orchestrator
+  only AFTER the final metrics read — the host must outlive the
+  actor/learner drain (it is the last process standing in the
+  shutdown barrier). The RPC `shutdown` method is the other exit.
+  """
+  proc.scrub_inherited_distributed_env()
+  state = _HostState(config)
+  server = rpc_lib.RpcServer(state.handle, authkey=config.authkey)
+  try:
+    ready_conn.send({"address": server.address})
+    ready_conn.close()
+    while not (stop_event.is_set() or state.shutdown_requested.is_set()):
+      proc.beat(heartbeat)
+      time.sleep(0.1)
+  finally:
+    server.close()
+    state.close()

@@ -1,0 +1,170 @@
+"""Fleet learner process: `train_qtopt` on the host's sharded store.
+
+The learner is the unmodified QT-Opt loop — same jitted Bellman step,
+same checkpoint writer, same metric logger — handed two fleet-shaped
+seams instead of an in-process buffer:
+
+  * `RemoteReplay` — the `replay_buffer=` facade. Sampling rides the
+    host's `ReplayBatchSampler` (so staleness is accounted where the
+    data lives), `set_learner_step` tags the store every dispatch
+    (the staleness + lag clock), and the train log's replay metrics
+    come back over the control channel. Two RPC clients on purpose:
+    the prefetch thread owns the sampling connection, the train loop
+    owns control — `rpc.RpcClient` is single-owner by design.
+  * `ParamPublishHook` — the Podracer param-publication channel. On
+    every checkpoint it ships the acting half of the train state
+    (params + batch stats, `opt_state` stripped — the same handoff
+    shape `ActorStateRefreshHook` uses in-process) to the host, which
+    hot-swaps it into the serving engine stamped with the learner
+    step. It declares `drives_online_collection`, so the trainer's
+    prefetch depth drops to the online-correct 1 (the round-5
+    sampling-lead finding applies to fleets too).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, Iterator, Optional
+
+from tensor2robot_tpu.fleet import proc
+from tensor2robot_tpu.fleet.rpc import RpcClient
+from tensor2robot_tpu.hooks.hook import Hook
+
+log = logging.getLogger(__name__)
+
+
+class RemoteReplay:
+  """`train_qtopt`-facing replay facade over the fleet host."""
+
+  def __init__(self, control: RpcClient, stream: RpcClient,
+               capacity: int):
+    self._control = control
+    self._stream = stream
+    self._capacity = int(capacity)
+
+  @property
+  def capacity(self) -> int:
+    return self._capacity
+
+  def __len__(self) -> int:
+    return int(self._control.call("size"))
+
+  def wait_until_size(self, min_size: int,
+                      timeout_secs: Optional[float] = None) -> bool:
+    deadline = (time.monotonic() + timeout_secs
+                if timeout_secs is not None else None)
+    while len(self) < min_size:
+      if deadline is not None and time.monotonic() > deadline:
+        return False
+      time.sleep(0.05)
+    return True
+
+  def _to_struct(self, flat: Dict[str, Any]):
+    from tensor2robot_tpu.specs import TensorSpecStruct
+    return TensorSpecStruct.from_flat_dict(flat)
+
+  def sample(self, batch_size: int):
+    """Control-channel sample (int8 calibration runs pre-loop, on the
+    train thread, before the prefetcher owns the stream channel)."""
+    return self._to_struct(self._control.call("sample", int(batch_size)))
+
+  def as_stream(self, batch_size: int) -> Iterator[Any]:
+    def _gen():
+      while True:
+        yield self._to_struct(
+            self._stream.call("sample", int(batch_size)))
+    return _gen()
+
+  def set_learner_step(self, step: int) -> None:
+    self._control.call("set_learner_step", int(step))
+
+  def metrics_scalars(self) -> Dict[str, float]:
+    return self._control.call("metrics_scalars")
+
+
+class ParamPublishHook(Hook):
+  """Publishes each checkpoint's acting params to the fleet host."""
+
+  drives_online_collection = True
+
+  def __init__(self, control: RpcClient):
+    self._control = control
+    self.publishes = 0
+
+  def after_checkpoint(self, step: int, state, model_dir: str) -> None:
+    import jax
+
+    acting = (state.replace(opt_state=None)
+              if hasattr(state, "replace")
+              and hasattr(state, "opt_state") else state)
+    self._control.call("publish", {
+        "step": int(step),
+        "state": jax.device_get(acting),
+    })
+    self.publishes += 1
+
+
+class _HeartbeatHook(Hook):
+  """Stamps the orchestrator-visible heartbeat every train step."""
+
+  def __init__(self, heartbeat):
+    self._heartbeat = heartbeat
+
+  def after_step(self, step: int, metrics) -> None:
+    proc.beat(self._heartbeat)
+
+
+class _CrashAfterHook(Hook):
+  """Fault injection: kill the learner mid-run (tests/bench)."""
+
+  def __init__(self, crash_after_steps: int):
+    self._after = int(crash_after_steps)
+
+  def after_step(self, step: int, metrics) -> None:
+    if step >= self._after:
+      raise RuntimeError(
+          "injected learner crash "
+          "(FleetConfig.learner_crash_after_steps)")
+
+
+def learner_main(config, model_dir: str, address, heartbeat,
+                 coordinator_address: Optional[str] = None) -> None:
+  """Child-process entry: connect → train_qtopt → clean exit."""
+  proc.scrub_inherited_distributed_env()
+  if config.distributed_learner and coordinator_address:
+    # The orchestrator picked this address with
+    # ephemeral_coordinator_address(); adopt it before any jax use so
+    # concurrent fleets on one host never race on a fixed port.
+    proc.adopt_coordinator(coordinator_address)
+
+  control = RpcClient(tuple(address), authkey=config.authkey)
+  stream = RpcClient(tuple(address), authkey=config.authkey)
+  try:
+    from tensor2robot_tpu.parallel.distributed import (
+        maybe_initialize_distributed,
+    )
+    maybe_initialize_distributed()
+
+    from tensor2robot_tpu.fleet.host import _build_learner
+    from tensor2robot_tpu.research.qtopt.train_qtopt import train_qtopt
+
+    hello = control.call("hello")
+    replay = RemoteReplay(control, stream, capacity=hello["capacity"])
+    hooks = [ParamPublishHook(control), _HeartbeatHook(heartbeat)]
+    if config.learner_crash_after_steps:
+      hooks.append(_CrashAfterHook(config.learner_crash_after_steps))
+    train_qtopt(
+        learner=_build_learner(config),
+        model_dir=model_dir,
+        replay_buffer=replay,
+        max_train_steps=config.max_train_steps,
+        batch_size=config.batch_size,
+        min_replay_size=config.min_replay_size,
+        save_checkpoints_steps=config.publish_every_steps,
+        log_every_steps=config.log_every_steps,
+        hooks=hooks,
+        seed=config.seed)
+  finally:
+    stream.close()
+    control.close()

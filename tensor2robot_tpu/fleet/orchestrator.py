@@ -1,0 +1,479 @@
+"""Fleet orchestrator: the organs run together as one topology.
+
+PRs 1–6 built every organ of the scalable QT-Opt stack — bucketed AOT
+serving with lock-free hot-swap, the sharded replay service with
+measured staleness, the shm-ring host data plane, gloo-backed
+distributed init. This module is the composition layer: the Sebulba
+decomposition from "Podracer architectures for scalable RL"
+(PAPERS.md) as a process-supervising orchestrator on one host —
+
+    actor 0..N-1 ──act──▶ ┌───────────────────────┐
+        │                 │ host: CEMPolicyServer │
+        │ commit          │  + ReplayWriteService │ ◀─publish─ learner
+        └────────────────▶│  + ReplayStore        │ ──sample─▶ (train_qtopt)
+                          └───────────────────────┘
+
+Lifecycle contract (docs/FLEET.md):
+
+  * LAUNCH GATE — when gin configs are given, `run_t2r_trainer
+    --validate_only` runs as a pre-spawn subprocess; a typo'd binding
+    fails the launch in seconds instead of minutes into a fleet run.
+  * HEARTBEAT + EXIT-CODE SUPERVISION — the hard-death latching
+    pattern from `data/plane.py`: child exit codes are polled and the
+    first failure is LATCHED (later teardown noise never masks it);
+    each child additionally stamps a shared monotonic heartbeat so a
+    silently hung process is detected, not just a dead one.
+  * ACTOR-CRASH POLICY — `restart` (default): the actor process is
+    respawned under the same actor id, which re-opens its replay
+    session — the service aborts whatever the dead incarnation staged
+    (restart-with-session-abort), so partial episodes never land.
+    `abort`: any actor death takes the fleet down.
+  * LEARNER/HOST DEATH — always fatal: actors are stopped, everything
+    is torn down, and the latched error is raised.
+  * SHUTDOWN BARRIER — stop event → actors drain and exit → final
+    metrics are read → host flushes replay and exits → every child is
+    joined (escalating terminate→kill on timeout). `shutdown` proves
+    zero leaked processes; the fleet allocates no shm segments
+    (tests/test_fleet.py pins both).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import multiprocessing as mp
+import os
+import secrets
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from tensor2robot_tpu import config as gin
+from tensor2robot_tpu.fleet import actor as actor_lib
+from tensor2robot_tpu.fleet import host as host_lib
+from tensor2robot_tpu.fleet import learner as learner_lib
+from tensor2robot_tpu.fleet.rpc import RpcClient
+
+log = logging.getLogger(__name__)
+
+_ENVS = ("toy_grasp", "pose", "mujoco_pose")
+_CRASH_POLICIES = ("restart", "abort")
+_CRASH_MODES = ("raise", "hard", "mid_episode")
+_OVERFLOW = ("drop", "block")
+
+
+class FleetError(RuntimeError):
+  """A latched fleet failure (child death, hang, launch-gate reject)."""
+
+
+@gin.configurable
+@dataclasses.dataclass
+class FleetConfig:
+  """One fleet's topology + model + lifecycle knobs (picklable: the
+  same instance is shipped to every child process)."""
+
+  # Topology.
+  num_actors: int = 2
+  env: str = "mujoco_pose"
+  # Model (mirrors GraspingQModel/QTOptLearner constructor args so the
+  # host's serving tree and the learner's training tree match).
+  image_size: int = 32
+  action_dim: int = 2
+  torso_filters: Tuple[int, ...] = (16, 32)
+  head_filters: Tuple[int, ...] = (32, 32)
+  dense_sizes: Tuple[int, ...] = (32, 32)
+  cem_population: int = 64
+  cem_iterations: int = 2
+  cem_elites: int = 6
+  cem_inference: str = "bf16"
+  # Learner loop.
+  batch_size: int = 64
+  max_train_steps: int = 200
+  min_replay_size: Optional[int] = None
+  publish_every_steps: int = 25  # checkpoint == param-refresh cadence
+  log_every_steps: int = 25
+  # Actors.
+  batch_episodes: int = 16
+  epsilon: float = 0.1
+  # Replay plane.
+  replay_capacity: int = 4096
+  replay_shards: int = 2
+  queue_batches: int = 16
+  overflow: str = "drop"
+  # Serving plane.
+  serve_max_batch: int = 8
+  serve_max_wait_us: int = 200
+  # Lifecycle.
+  actor_crash_policy: str = "restart"
+  max_actor_restarts: int = 3
+  heartbeat_timeout_secs: float = 300.0  # 0 disables hang detection
+  launch_timeout_secs: float = 240.0
+  run_timeout_secs: float = 1800.0
+  distributed_learner: bool = False
+  seed: int = 0
+  authkey: bytes = b""  # per-fleet key generated at Fleet construction
+  # Fault injection (tests / bench failure-path rehearsal).
+  actor_crash_after_episodes: Optional[int] = None
+  actor_crash_mode: str = "raise"
+  crash_actor_index: int = 0
+  learner_crash_after_steps: Optional[int] = None
+
+  def __post_init__(self):
+    if not self.authkey:
+      # Per-fleet secret, generated at construction and shipped (via
+      # pickle) to every child: two fleets on one machine can never
+      # cross-connect. Never b"" — a falsy authkey makes the stdlib
+      # Listener SKIP the auth challenge the Client then waits for
+      # (a handshake deadlock, found the hard way).
+      self.authkey = secrets.token_bytes(16)
+    if self.num_actors < 1:
+      raise ValueError(f"num_actors must be >= 1, got {self.num_actors}")
+    if self.env not in _ENVS:
+      raise ValueError(f"env must be one of {_ENVS}, got {self.env!r}")
+    if self.actor_crash_policy not in _CRASH_POLICIES:
+      raise ValueError(
+          f"actor_crash_policy must be one of {_CRASH_POLICIES}, got "
+          f"{self.actor_crash_policy!r}")
+    if self.actor_crash_mode not in _CRASH_MODES:
+      raise ValueError(
+          f"actor_crash_mode must be one of {_CRASH_MODES}, got "
+          f"{self.actor_crash_mode!r}")
+    if self.overflow not in _OVERFLOW:
+      raise ValueError(
+          f"overflow must be one of {_OVERFLOW}, got {self.overflow!r}")
+
+
+@dataclasses.dataclass
+class FleetResult:
+  """What a completed fleet run measured (the bench `fleet` axis)."""
+
+  env_steps_per_sec: float
+  learner_steps_per_sec: float
+  param_refresh_lag: Dict[str, Any]
+  replay_staleness: Dict[str, Any]
+  publishes: int
+  params_version: int
+  actor_restarts: int
+  wall_secs: float
+  clean_shutdown: bool
+  metrics: Dict[str, Any]
+
+
+class Fleet:
+  """Launches, supervises, and tears down one learner/actor fleet."""
+
+  def __init__(self, config: FleetConfig, model_dir: str,
+               gin_configs: Sequence[str] = ()):
+    self.config = config
+    self.model_dir = model_dir
+    self.gin_configs = tuple(gin_configs)
+    self._ctx = mp.get_context("spawn")
+    # Two stop signals on purpose: `_stop` drains the ACTORS, while
+    # the host has its own — it must outlive the actor/learner drain
+    # so the final metrics read has someone to talk to.
+    self._stop = self._ctx.Event()
+    self._host_stop = self._ctx.Event()
+    self._host: Optional[mp.Process] = None
+    self._learner: Optional[mp.Process] = None
+    self._actors: Dict[int, mp.Process] = {}
+    self._heartbeats: Dict[str, Any] = {}
+    self._spawned_at: Dict[str, float] = {}
+    self._restarts: Dict[int, int] = {}
+    self._control: Optional[RpcClient] = None
+    self._address: Optional[Tuple[str, int]] = None
+    self._error: Optional[BaseException] = None
+    self._launched = False
+    self._closed = False
+    self._t_launched: Optional[float] = None
+
+  # ---- launch ----
+
+  def _run_launch_gate(self) -> None:
+    """`run_t2r_trainer --validate_only` as the pre-spawn gate."""
+    for config_path in self.gin_configs:
+      result = subprocess.run(
+          [sys.executable, "-m",
+           "tensor2robot_tpu.bin.run_t2r_trainer",
+           "--validate_only", "--gin_configs", config_path],
+          capture_output=True, text=True, timeout=300)
+      if result.returncode != 0:
+        raise FleetError(
+            f"launch gate rejected {config_path!r} "
+            f"(validate_only exit {result.returncode}):\n"
+            f"{result.stdout}\n{result.stderr}")
+
+  def _heartbeat(self, name: str):
+    value = self._ctx.Value("d", time.monotonic())
+    self._heartbeats[name] = value
+    self._spawned_at[name] = time.monotonic()
+    return value
+
+  def _spawn_actor(self, index: int, incarnation: int) -> None:
+    name = f"t2r-fleet-actor-{index}"
+    heartbeat = self._heartbeat(name)
+    process = self._ctx.Process(
+        target=actor_lib.actor_main,
+        args=(self.config, index, self._address, self._stop, heartbeat,
+              incarnation),
+        name=name, daemon=True)
+    process.start()
+    self._actors[index] = process
+
+  def launch(self) -> None:
+    """Gate → host (handshake) → actors → learner."""
+    if self._launched:
+      return
+    self._run_launch_gate()
+    config = self.config
+    parent_conn, child_conn = self._ctx.Pipe()
+    self._host = self._ctx.Process(
+        target=host_lib.host_main,
+        args=(config, child_conn, self._host_stop,
+              self._heartbeat("t2r-fleet-host")),
+        name="t2r-fleet-host", daemon=True)
+    self._host.start()
+    child_conn.close()
+    # Handshake: the host reports its bound RPC address once its
+    # engine is warm; a host that died compiling surfaces here with
+    # its exit code instead of a silent hang.
+    if not parent_conn.poll(config.launch_timeout_secs):
+      self._latch(FleetError(
+          f"host did not report ready within "
+          f"{config.launch_timeout_secs:.0f}s "
+          f"(exitcode={self._host.exitcode})"))
+      self._abort()
+      raise self._error
+    try:
+      info = parent_conn.recv()
+    except (EOFError, OSError):
+      # poll() also returns True on EOF: a host that died DURING
+      # construction (bad config, import failure) lands here, not in
+      # the timeout branch — same latch/abort treatment.
+      self._host.join(timeout=10.0)
+      self._latch(FleetError(
+          "host died before reporting ready "
+          f"(exitcode={self._host.exitcode})"))
+      self._abort()
+      raise self._error from None
+    parent_conn.close()
+    self._address = tuple(info["address"])
+    self._control = RpcClient(self._address, authkey=config.authkey)
+    for index in range(config.num_actors):
+      self._restarts[index] = 0
+      self._spawn_actor(index, incarnation=0)
+    coordinator_address = None
+    if config.distributed_learner:
+      from tensor2robot_tpu.parallel.distributed import (
+          ephemeral_coordinator_address,
+      )
+      coordinator_address = ephemeral_coordinator_address()
+    self._learner = self._ctx.Process(
+        target=learner_lib.learner_main,
+        args=(config, self.model_dir, self._address,
+              self._heartbeat("t2r-fleet-learner"), coordinator_address),
+        name="t2r-fleet-learner", daemon=True)
+    self._learner.start()
+    self._launched = True
+    self._t_launched = time.monotonic()
+
+  # ---- supervision ----
+
+  def _latch(self, error: BaseException) -> None:
+    """First failure wins — the data/plane.py latch pattern: teardown
+    noise after the latch never replaces the root cause."""
+    if self._error is None:
+      self._error = error
+
+  def _check_heartbeats(self) -> None:
+    timeout = self.config.heartbeat_timeout_secs
+    if not timeout:
+      return
+    now = time.monotonic()
+    for name, value in self._heartbeats.items():
+      last = max(value.value, self._spawned_at.get(name, 0.0))
+      if now - last > timeout:
+        raise FleetError(
+            f"{name} heartbeat stale for {now - last:.0f}s "
+            f"(> {timeout:.0f}s): process hung")
+
+  def _supervise_once(self) -> bool:
+    """One poll; returns True when the learner finished cleanly."""
+    learner = self._learner
+    if learner.exitcode is not None:
+      if learner.exitcode == 0:
+        return True
+      raise FleetError(
+          f"learner died (exit {learner.exitcode}); stopping actors")
+    if self._host.exitcode is not None:
+      raise FleetError(
+          f"replay/serving host died (exit {self._host.exitcode})")
+    for index, process in list(self._actors.items()):
+      if process.exitcode is None:
+        continue
+      # Any exit while the fleet is running is a crash (clean actor
+      # exits only happen after the stop event in shutdown).
+      if (self.config.actor_crash_policy == "restart"
+          and self._restarts[index] < self.config.max_actor_restarts):
+        self._restarts[index] += 1
+        log.warning(
+            "actor %d died (exit %s); restart %d/%d — session will "
+            "reopen with abort-of-staged-rows", index, process.exitcode,
+            self._restarts[index], self.config.max_actor_restarts)
+        self._spawn_actor(index, incarnation=self._restarts[index])
+      else:
+        raise FleetError(
+            f"actor {index} died (exit {process.exitcode}) under "
+            f"policy={self.config.actor_crash_policy!r} after "
+            f"{self._restarts[index]} restart(s)")
+    self._check_heartbeats()
+    return False
+
+  def wait(self) -> None:
+    """Blocks until the learner exits cleanly; on any latched failure
+    the fleet is aborted (all children stopped) and the error raised."""
+    deadline = self._t_launched + self.config.run_timeout_secs
+    try:
+      while True:
+        if self._supervise_once():
+          return
+        if time.monotonic() > deadline:
+          raise FleetError(
+              f"fleet exceeded run_timeout_secs="
+              f"{self.config.run_timeout_secs:.0f}")
+        time.sleep(0.05)
+    except BaseException as e:
+      self._latch(e)
+      self._abort()
+      raise self._error from None
+
+  # ---- shutdown ----
+
+  def _join_or_kill(self, process: mp.Process, timeout_secs: float,
+                    what: str) -> None:
+    process.join(timeout=timeout_secs)
+    if process.is_alive():
+      log.warning("%s did not exit within %.0fs; terminating",
+                  what, timeout_secs)
+      process.terminate()
+      process.join(timeout=5.0)
+    if process.is_alive():
+      process.kill()
+      process.join(timeout=5.0)
+
+  def _all_processes(self) -> List[mp.Process]:
+    procs = list(self._actors.values())
+    if self._learner is not None:
+      procs.append(self._learner)
+    if self._host is not None:
+      procs.append(self._host)
+    return [p for p in procs if p is not None]
+
+  def shutdown(self, timeout_secs: float = 60.0,
+               collect_metrics: bool = True) -> Optional[Dict[str, Any]]:
+    """The shutdown barrier: actors → final metrics → host → joined.
+
+    Returns the host's final metrics (None when `collect_metrics` is
+    off or the host is already gone). Raises `FleetError` if any child
+    survives the barrier — the zero-leak contract is checked, not
+    assumed.
+    """
+    if self._closed:
+      return None
+    self._closed = True
+    self._stop.set()
+    for index, process in self._actors.items():
+      self._join_or_kill(process, timeout_secs / 2,
+                         f"actor {index}")
+    metrics = None
+    if (collect_metrics and self._control is not None
+        and self._host is not None and self._host.is_alive()):
+      try:
+        metrics = self._control.call("metrics", timeout_secs=30.0)
+      except Exception:
+        log.warning("final metrics read failed", exc_info=True)
+    self._host_stop.set()
+    if self._control is not None:
+      if self._host is not None and self._host.is_alive():
+        try:
+          self._control.call("shutdown", timeout_secs=10.0)
+        except Exception:
+          log.warning("host shutdown rpc failed (will join/terminate)",
+                      exc_info=True)
+      self._control.close()
+      self._control = None
+    if self._learner is not None:
+      self._join_or_kill(self._learner, timeout_secs / 2, "learner")
+    if self._host is not None:
+      self._join_or_kill(self._host, timeout_secs / 2, "host")
+    leaked = [p.name for p in self._all_processes() if p.is_alive()]
+    if leaked:
+      raise FleetError(f"shutdown leaked processes: {leaked}")
+    return metrics
+
+  def _abort(self) -> None:
+    """Failure-path teardown: no metrics, everything force-stopped."""
+    try:
+      self.shutdown(timeout_secs=20.0, collect_metrics=False)
+    except FleetError:
+      log.exception("abort teardown incomplete")
+
+  # ---- the whole run ----
+
+  def run(self) -> FleetResult:
+    """launch → wait → metrics → shutdown, as one supervised unit."""
+    t0 = time.monotonic()
+    self.launch()
+    self.wait()
+    metrics = self.shutdown()
+    wall = time.monotonic() - t0
+    if metrics is None:
+      raise FleetError("fleet completed but final metrics were lost")
+    return _result_from_metrics(metrics, wall, sum(
+        self._restarts.values()))
+
+
+def _result_from_metrics(metrics: Dict[str, Any], wall_secs: float,
+                         actor_restarts: int) -> FleetResult:
+  service = metrics.get("service", {})
+  committed = float(service.get("replay_committed_transitions", 0.0))
+  commit_window = metrics.get("commit_window") or {}
+  commit_span = max(
+      float(commit_window.get("last_time", 0.0))
+      - float(commit_window.get("first_time", 0.0)), 1e-9)
+  learner_window = metrics.get("learner_window") or {}
+  step_span = (float(learner_window.get("last_step", 0))
+               - float(learner_window.get("first_step", 0)))
+  time_span = max(float(learner_window.get("last_time", 0.0))
+                  - float(learner_window.get("first_time", 0.0)), 1e-9)
+  return FleetResult(
+      env_steps_per_sec=committed / commit_span,
+      learner_steps_per_sec=step_span / time_span,
+      param_refresh_lag=metrics.get("param_refresh_lag", {}),
+      replay_staleness=metrics.get("staleness", {}),
+      publishes=int(metrics.get("publishes", 0)),
+      params_version=int(metrics.get("params_version", 0)),
+      actor_restarts=actor_restarts,
+      wall_secs=wall_secs,
+      clean_shutdown=True,
+      metrics=metrics,
+  )
+
+
+@gin.configurable
+def run_fleet(model_dir: str = gin.REQUIRED,
+              config: Optional[FleetConfig] = None,
+              gin_configs: Sequence[str] = ()) -> FleetResult:
+  """Gin entry point (`run_t2r_trainer --trainer=fleet`): runs one
+  fleet to completion and returns its measured result."""
+  config = config or FleetConfig()
+  os.makedirs(model_dir, exist_ok=True)
+  fleet = Fleet(config, model_dir, gin_configs=gin_configs)
+  result = fleet.run()
+  log.info(
+      "fleet complete: %.1f env steps/s, %.1f learner steps/s, "
+      "param_refresh_lag mean %.1f steps, %d publishes, %d restarts",
+      result.env_steps_per_sec, result.learner_steps_per_sec,
+      result.param_refresh_lag.get("mean", 0.0), result.publishes,
+      result.actor_restarts)
+  return result

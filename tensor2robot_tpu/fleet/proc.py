@@ -1,0 +1,62 @@
+"""Shared helpers for fleet child processes (host / actors / learner).
+
+Every fleet child runs this module's `scrub_inherited_distributed_env`
+FIRST: a fleet is often launched from a process that itself sits
+inside a multi-host training context (`JAX_COORDINATOR_ADDRESS` and
+friends in the environment), and `multiprocessing`'s spawn children
+inherit the parent's environ wholesale. A fleet child that kept those
+variables would call `jax.distributed.initialize` against a
+coordinator it is not part of and block forever waiting for peers —
+the exact class of same-host collision the collision-safe coordinator
+contract exists to prevent (see
+`parallel.distributed.ephemeral_coordinator_address`). Children that
+DO want a distributed runtime (the learner with
+`FleetConfig.distributed_learner=True`) get a fresh ephemeral
+coordinator address handed to them explicitly by the orchestrator.
+
+Kept jax-free so actor processes can import it without paying the XLA
+runtime (pinned by tests/test_fleet.py).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+# The launch-contract variables `maybe_initialize_distributed` reads.
+_DISTRIBUTED_ENV_VARS = (
+    "JAX_COORDINATOR_ADDRESS",
+    "JAX_NUM_PROCESSES",
+    "JAX_PROCESS_ID",
+)
+
+
+def scrub_inherited_distributed_env() -> None:
+  """Drops inherited multi-host launch variables from this process."""
+  for var in _DISTRIBUTED_ENV_VARS:
+    os.environ.pop(var, None)
+
+
+def adopt_coordinator(address: str, num_processes: int = 1,
+                      process_id: int = 0) -> None:
+  """Installs an orchestrator-issued coordinator triple into env.
+
+  The orchestrator (not the child) picked `address` with
+  `ephemeral_coordinator_address()`, so two fleets on one machine can
+  never race on a fixed port; the child just adopts it before its
+  first jax import.
+  """
+  os.environ["JAX_COORDINATOR_ADDRESS"] = address
+  os.environ["JAX_NUM_PROCESSES"] = str(num_processes)
+  os.environ["JAX_PROCESS_ID"] = str(process_id)
+
+
+def beat(heartbeat) -> None:
+  """Stamps a shared heartbeat slot with the current monotonic time.
+
+  `heartbeat` is a `multiprocessing.Value('d')`; CLOCK_MONOTONIC is
+  system-wide on Linux, so the orchestrator compares stamps from any
+  process against its own clock.
+  """
+  if heartbeat is not None:
+    heartbeat.value = time.monotonic()

@@ -1,0 +1,217 @@
+"""Loopback RPC for the fleet: actors/learner ⇄ replay/serving host.
+
+The Podracer decomposition (PAPERS.md, "Podracer architectures for
+scalable RL") puts the environment loops, the inference server, the
+replay service, and the learner in separate PROCESSES; what connects
+them is a small request/response protocol. This module is that
+protocol's transport, built on `multiprocessing.connection` (stdlib
+pickle framing over a loopback TCP socket — no new dependency, and the
+same `Listener`/`Client` pair a real multi-host deployment would swap
+for its RPC system of choice):
+
+  * `RpcServer` — accept loop + one handler thread per connection.
+    The handler callable sees `(method, payload, ctx)` where `ctx` is
+    a per-connection dict that SURVIVES until disconnect: the host
+    stores each connection's replay-session ids there, and the
+    synthetic `__disconnect__` call on EOF is how a crashed actor's
+    staged half-episode gets aborted server-side (the session-abort
+    crash contract of `replay.service`, extended across the process
+    boundary).
+  * `RpcClient` — blocking request/response. NOT thread-safe by
+    design: one owner thread per client. A process that needs RPC
+    from two threads (the learner's train loop + its prefetch thread)
+    opens two clients — loopback connections are cheap, and two
+    sockets beat a lock that would serialize a param publish behind a
+    slow sample (and trip the CON301 blocking-under-lock rule this
+    package is linted with).
+
+This module must stay importable WITHOUT jax: actor processes import
+it at spawn and never touch a device (tests/test_fleet.py pins the
+jax-free actor import).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import traceback
+from multiprocessing.connection import Client, Listener
+from typing import Any, Callable, List, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+# The shared secret for connection auth. Loopback-only transport; the
+# orchestrator generates a per-fleet key so two fleets on one machine
+# cannot cross-connect even if they guess each other's port.
+DEFAULT_AUTHKEY = b"t2r-fleet"
+
+DISCONNECT_METHOD = "__disconnect__"
+
+
+class RpcError(RuntimeError):
+  """A handler raised on the server side; carries the remote traceback."""
+
+
+class RpcServer:
+  """Threaded request/response server over a loopback Listener."""
+
+  def __init__(self,
+               handler: Callable[[str, Any, dict], Any],
+               host: str = "127.0.0.1",
+               authkey: bytes = DEFAULT_AUTHKEY):
+    """`handler(method, payload, ctx) -> result` runs on a
+    per-connection thread; exceptions it raises are serialized back to
+    the caller as `RpcError` (the connection stays up). On EOF the
+    synthetic `(DISCONNECT_METHOD, None, ctx)` call runs once."""
+    self._handler = handler
+    self._listener = Listener((host, 0), authkey=authkey)
+    self.address: Tuple[str, int] = self._listener.address
+    self._stop = threading.Event()
+    self._lock = threading.Lock()
+    self._conns: List[Any] = []
+    self._threads: List[threading.Thread] = []
+    self._accept_thread = threading.Thread(
+        target=self._accept_loop, name="fleet-rpc-accept", daemon=True)
+    self._accept_thread.start()
+
+  def _accept_loop(self) -> None:
+    while not self._stop.is_set():
+      try:
+        conn = self._listener.accept()
+      except (OSError, EOFError):
+        # close() closed the listener under us (the only way to
+        # unblock accept); anything else on a closed socket is the
+        # same shutdown signal.
+        return
+      except Exception:  # auth failure from a stray connector
+        log.warning("fleet rpc: rejected connection", exc_info=True)
+        continue
+      thread = threading.Thread(
+          target=self._serve, args=(conn,),
+          name="fleet-rpc-conn", daemon=True)
+      with self._lock:
+        self._conns.append(conn)
+        self._threads.append(thread)
+      thread.start()
+
+  def _serve(self, conn) -> None:
+    ctx: dict = {}
+    try:
+      while not self._stop.is_set():
+        try:
+          method, payload = conn.recv()
+        except (EOFError, OSError):
+          break
+        try:
+          result = self._handler(method, payload, ctx)
+          reply = ("ok", result)
+        except BaseException:  # serialized back, connection stays up
+          reply = ("err", traceback.format_exc())
+        try:
+          conn.send(reply)
+        except (EOFError, OSError):
+          break
+    finally:
+      try:
+        self._handler(DISCONNECT_METHOD, None, ctx)
+      except Exception:
+        log.exception("fleet rpc: disconnect handler failed")
+      try:
+        conn.close()
+      except OSError:
+        pass
+      with self._lock:
+        if conn in self._conns:
+          self._conns.remove(conn)
+
+  def close(self, timeout_secs: float = 5.0) -> None:
+    """Stops intake: closes the listener (unblocks accept) and every
+    live connection (unblocks recv), then joins the handler threads."""
+    self._stop.set()
+    try:
+      self._listener.close()
+    except OSError:
+      pass
+    with self._lock:
+      conns = list(self._conns)
+      threads = list(self._threads)
+    for conn in conns:
+      try:
+        conn.close()
+      except OSError:
+        pass
+    deadline = time.monotonic() + timeout_secs
+    for thread in threads + [self._accept_thread]:
+      thread.join(timeout=max(0.0, deadline - time.monotonic()))
+
+  def __enter__(self) -> "RpcServer":
+    return self
+
+  def __exit__(self, *exc) -> bool:
+    self.close()
+    return False
+
+
+class RpcClient:
+  """Blocking request/response client. One owner thread per instance
+  (see module docstring) — open a second client for a second thread."""
+
+  def __init__(self,
+               address: Tuple[str, int],
+               authkey: bytes = DEFAULT_AUTHKEY,
+               connect_timeout_secs: float = 20.0):
+    deadline = time.monotonic() + connect_timeout_secs
+    last_error: Optional[BaseException] = None
+    self._conn = None
+    while True:
+      try:
+        self._conn = Client(tuple(address), authkey=authkey)
+        break
+      except (ConnectionRefusedError, FileNotFoundError) as e:
+        # The host process may still be warming up its engine; retry
+        # until the connect window closes.
+        last_error = e
+        if time.monotonic() > deadline:
+          raise TimeoutError(
+              f"fleet rpc: no server at {address} after "
+              f"{connect_timeout_secs:.0f}s") from last_error
+        time.sleep(0.05)
+
+  def call(self, method: str, payload: Any = None,
+           timeout_secs: Optional[float] = None) -> Any:
+    """One request/response round trip; raises `RpcError` when the
+    server-side handler raised (its traceback is the message).
+
+    `timeout_secs` bounds the wait for the REPLY (the orchestrator's
+    shutdown path must not hang on a wedged host); on expiry the
+    client raises `TimeoutError` and the connection should be
+    considered poisoned (an in-flight reply may still arrive).
+    """
+    try:
+      self._conn.send((method, payload))
+      if timeout_secs is not None and not self._conn.poll(timeout_secs):
+        raise TimeoutError(
+            f"fleet rpc: no reply to {method!r} in {timeout_secs:.0f}s")
+      status, value = self._conn.recv()
+    except (EOFError, OSError) as e:
+      raise ConnectionError(
+          f"fleet rpc: server dropped during {method!r}") from e
+    if status == "err":
+      raise RpcError(f"remote {method!r} failed:\n{value}")
+    return value
+
+  def close(self) -> None:
+    if self._conn is not None:
+      try:
+        self._conn.close()
+      except OSError:
+        pass
+      self._conn = None
+
+  def __enter__(self) -> "RpcClient":
+    return self
+
+  def __exit__(self, *exc) -> bool:
+    self.close()
+    return False

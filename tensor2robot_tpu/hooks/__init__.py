@@ -1,8 +1,45 @@
-"""Trainer hooks (reference: tensor2robot hooks/ SessionRunHook builders)."""
+"""Trainer hooks (reference: tensor2robot hooks/ SessionRunHook builders).
 
+Exports resolve LAZILY (PEP 562, the `data/__init__` pattern): the
+base `Hook`/`HookList` protocol is pure Python and is imported by
+fleet actor/learner process entry modules at spawn, but
+`async_export_hook` drags jax at module level — an eager package init
+would pull the XLA runtime into jax-free actor processes
+(tests/test_fleet.py pins the import). Gin registration for the
+configurable hooks is declared via `register_lazy_configurables` so
+shipped configs (`@SuccessEvalHook()`, ...) still resolve right after
+`run_t2r_trainer`'s bare package import.
+"""
+
+from tensor2robot_tpu import config as _gin
+# The protocol itself stays eager: it is jax-free and nearly every
+# consumer wants it.
 from tensor2robot_tpu.hooks.hook import Hook, HookList
-from tensor2robot_tpu.hooks.async_export_hook import AsyncExportHook
-from tensor2robot_tpu.hooks.success_eval_hook import (
-    QTOptSuccessEvalHook,
-    SuccessEvalHook,
-)
+
+_EXPORTS = {
+    "AsyncExportHook": "async_export_hook",
+    "QTOptSuccessEvalHook": "success_eval_hook",
+    "SuccessEvalHook": "success_eval_hook",
+}
+
+__all__ = ["Hook", "HookList"] + sorted(_EXPORTS)
+
+# Every lazy export here is a @gin.configurable (unlike the
+# qtopt/pose_env inits, where the registered set is a deliberate
+# subset), so _EXPORTS is the single source of truth.
+for _name, _mod in _EXPORTS.items():
+  _gin.register_lazy_configurables(f"{__name__}.{_mod}", (_name,))
+del _name, _mod
+
+
+def __getattr__(name):
+  module_name = _EXPORTS.get(name)
+  if module_name is None:
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
+  import importlib
+
+  module = importlib.import_module(f"{__name__}.{module_name}")
+  value = getattr(module, name)
+  globals()[name] = value
+  return value

@@ -26,6 +26,31 @@ log = logging.getLogger(__name__)
 _INITIALIZED = False
 
 
+def ephemeral_coordinator_address(host: str = "127.0.0.1") -> str:
+  """Picks a collision-safe coordinator address for same-host launches.
+
+  The launch contract for same-host multi-process runs (fleets, the
+  two-process distributed test, bench rehearsals): the COORDINATOR —
+  the one process that spawns the others — calls this ONCE before
+  spawning and hands the result to every child via
+  `JAX_COORDINATOR_ADDRESS` (or the explicit flag). The OS assigns a
+  port from the ephemeral range (`bind(0)`), so two concurrent fleets
+  (or bench + tests) on one machine never race on a fixed port the
+  way a hard-coded constant guarantees they eventually would.
+
+  The port is released before jax binds it, so a theoretical window
+  exists; ephemeral-range assignment makes a collision in that window
+  vanishingly unlikely (the kernel cycles the range rather than
+  re-issuing the port it just handed out), which is the practical
+  difference vs. a fixed port's CERTAIN collision under concurrency.
+  """
+  import socket
+
+  with socket.socket() as s:
+    s.bind((host, 0))
+    return f"{host}:{s.getsockname()[1]}"
+
+
 def maybe_initialize_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,

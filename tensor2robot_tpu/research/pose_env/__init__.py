@@ -1,13 +1,43 @@
-"""pose_env research family (reference: tensor2robot research/pose_env/)."""
+"""pose_env research family (reference: tensor2robot research/pose_env/).
 
-from tensor2robot_tpu.research.pose_env.pose_env import (
-    PoseEnv,
-    collect_random_episodes,
-    evaluate_pose_model,
-)
-from tensor2robot_tpu.research.pose_env.mujoco_pose_env import (
-    MuJoCoPoseEnv,
-)
-from tensor2robot_tpu.research.pose_env.pose_env_models import (
-    PoseEnvRegressionModel,
-)
+Exports resolve LAZILY (PEP 562, the `data/__init__` pattern): fleet
+actor processes import `grasp_bandit` (numpy + mujoco) at spawn, and
+an eager package init would drag `pose_env_models`' jax import into
+processes that only step physics and speak RPC. Gin registration is
+declared via `register_lazy_configurables` so shipped configs resolve
+these names right after `run_t2r_trainer`'s bare package import.
+"""
+
+from tensor2robot_tpu import config as _gin
+
+_EXPORTS = {
+    "PoseEnv": "pose_env",
+    "collect_random_episodes": "pose_env",
+    "evaluate_pose_model": "pose_env",
+    "MuJoCoPoseEnv": "mujoco_pose_env",
+    "PoseEnvRegressionModel": "pose_env_models",
+    "PoseGraspBandit": "grasp_bandit",
+}
+
+__all__ = sorted(_EXPORTS)
+
+for _name, _mod in (("collect_random_episodes", "pose_env"),
+                    ("evaluate_pose_model", "pose_env"),
+                    ("MuJoCoPoseEnv", "mujoco_pose_env"),
+                    ("PoseEnvRegressionModel", "pose_env_models"),
+                    ("PoseGraspBandit", "grasp_bandit")):
+  _gin.register_lazy_configurables(f"{__name__}.{_mod}", (_name,))
+del _name, _mod
+
+
+def __getattr__(name):
+  module_name = _EXPORTS.get(name)
+  if module_name is None:
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
+  import importlib
+
+  module = importlib.import_module(f"{__name__}.{module_name}")
+  value = getattr(module, name)
+  globals()[name] = value
+  return value

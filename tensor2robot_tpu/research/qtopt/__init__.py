@@ -1,23 +1,59 @@
-"""QT-Opt research family (reference: tensor2robot research/qtopt/)."""
+"""QT-Opt research family (reference: tensor2robot research/qtopt/).
 
-from tensor2robot_tpu.research.qtopt.actor import (
-    ActorStateRefreshHook,
-    GraspActor,
-)
-from tensor2robot_tpu.research.qtopt.cem import (
-    CEMResult,
-    cem_maximize,
-    make_q_score_fn,
-)
-from tensor2robot_tpu.research.qtopt.grasping_env import (
-    ToyGraspEnv,
-    evaluate_grasp_policy,
-)
-from tensor2robot_tpu.research.qtopt.networks import GraspingQNetwork
-from tensor2robot_tpu.research.qtopt.qtopt_learner import (
-    QTOptLearner,
-    QTOptState,
-)
-from tensor2robot_tpu.research.qtopt.replay_buffer import ReplayBuffer
-from tensor2robot_tpu.research.qtopt.t2r_models import GraspingQModel
-from tensor2robot_tpu.research.qtopt.train_qtopt import train_qtopt
+Exports resolve LAZILY (PEP 562, the `data/__init__` pattern): fleet
+actor processes import `research.qtopt.actor` + `grasping_env` at
+spawn, and an eager package init would drag `qtopt_learner`'s jax
+import (seconds of spin-up, an XLA runtime of memory) into processes
+that only step envs and speak RPC (tests/test_fleet.py pins the
+jax-free actor import). Consumers see the same names; only the import
+moment moves.
+
+Gin registration must NOT move with it: `run_t2r_trainer` parses
+shipped configs right after importing this package, so every
+`@gin.configurable` below is declared via
+`register_lazy_configurables` — the first config reference imports the
+defining submodule (registering it) instead of failing unregistered.
+"""
+
+from tensor2robot_tpu import config as _gin
+
+_EXPORTS = {
+    "ActorStateRefreshHook": "actor",
+    "GraspActor": "actor",
+    "CEMResult": "cem",
+    "cem_maximize": "cem",
+    "make_q_score_fn": "cem",
+    "ToyGraspEnv": "grasping_env",
+    "evaluate_grasp_policy": "grasping_env",
+    "GraspingQNetwork": "networks",
+    "QTOptLearner": "qtopt_learner",
+    "QTOptState": "qtopt_learner",
+    "ReplayBuffer": "replay_buffer",
+    "GraspingQModel": "t2r_models",
+    "train_qtopt": "train_qtopt",
+}
+
+__all__ = sorted(_EXPORTS)
+
+for _name, _mod in (("GraspActor", "actor"),
+                    ("ActorStateRefreshHook", "actor"),
+                    ("evaluate_grasp_policy", "grasping_env"),
+                    ("QTOptLearner", "qtopt_learner"),
+                    ("ReplayBuffer", "replay_buffer"),
+                    ("GraspingQModel", "t2r_models"),
+                    ("train_qtopt", "train_qtopt")):
+  _gin.register_lazy_configurables(f"{__name__}.{_mod}", (_name,))
+del _name, _mod
+
+
+def __getattr__(name):
+  module_name = _EXPORTS.get(name)
+  if module_name is None:
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
+  import importlib
+
+  module = importlib.import_module(f"{__name__}.{module_name}")
+  value = getattr(module, name)
+  globals()[name] = value
+  return value

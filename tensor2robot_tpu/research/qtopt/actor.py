@@ -38,7 +38,7 @@ from __future__ import annotations
 
 import logging
 import threading
-from typing import Optional
+from typing import Dict, Optional
 
 import numpy as np
 
@@ -69,8 +69,6 @@ class GraspActor:
                seed: int = 0,
                policy_server=None,
                name: Optional[str] = None):
-    import jax
-
     self._learner = learner
     self._replay = replay_buffer
     self.name = name or f"actor-{seed}"
@@ -81,6 +79,10 @@ class GraspActor:
                      if hasattr(replay_buffer, "session") else None)
     self._session = (self._service.session(self.name)
                      if self._service is not None else None)
+    if env is None and learner is None:
+      raise ValueError(
+          "GraspActor needs either an env or a learner (the default "
+          "env is sized from the learner's model).")
     self._env = env or ToyGraspEnv(
         image_size=learner.model.image_size,
         action_dim=learner.model.action_dim, seed=seed)
@@ -88,13 +90,19 @@ class GraspActor:
     self._epsilon = float(epsilon)
     self.policy_server = policy_server
     if policy_server is None:
+      # jax loads ONLY on the local-policy path: server-wired actors
+      # (fleet processes) never touch a device and must not pay the
+      # XLA runtime import (pinned by tests/test_fleet.py).
+      import jax
+
       self._policy = jax.jit(learner.build_policy(
           cem_population=cem_population,
           cem_iterations=cem_iterations))
+      self._jax_key = jax.random.PRNGKey(seed + 1)
     else:
       self._policy = None
+      self._jax_key = None
     self._rng = np.random.default_rng(seed)
-    self._jax_key = jax.random.PRNGKey(seed + 1)
     self._state = None
     self._state_lock = threading.Lock()
     self._stop = threading.Event()
@@ -104,6 +112,12 @@ class GraspActor:
     self.reward_sum = 0.0
     self.crashed = False
     self.crash_error: Optional[BaseException] = None
+    # Per-episode policy attribution (the param_refresh_lag seam):
+    # when the action source exposes `params_version` (CEMPolicyServer
+    # / the fleet's policy client), every collected batch records the
+    # version it acted with.
+    self.last_policy_version: Optional[int] = None
+    self.episodes_by_policy_version: Dict[int, int] = {}
 
   def update_state(self, state) -> None:
     """Swaps the acting parameters (called from the trainer thread).
@@ -123,18 +137,24 @@ class GraspActor:
 
   def _greedy_actions(self, observations, n: int) -> np.ndarray:
     """CEM actions for the batch via the configured action source."""
-    import jax
-    from tensor2robot_tpu.specs import TensorSpecStruct
-
     if self.policy_server is not None:
       # Through the serving stack: chunk to the engine's max_batch (a
-      # fleet's request sizes all hit pre-compiled buckets).
+      # fleet's request sizes all hit pre-compiled buckets). No jax on
+      # this path — a server-wired actor process stays device-free.
       chunk = self.policy_server.engine.max_batch
       outs = []
       for lo in range(0, n, chunk):
         outs.append(self.policy_server.select_actions(
             {"image": observations["image"][lo:lo + chunk]}))
+      version = getattr(self.policy_server, "params_version", None)
+      if version is not None:
+        self.last_policy_version = version
+        self.episodes_by_policy_version[version] = (
+            self.episodes_by_policy_version.get(version, 0) + n)
       return np.concatenate(outs, axis=0).astype(np.float32)
+    import jax
+    from tensor2robot_tpu.specs import TensorSpecStruct
+
     with self._state_lock:
       state = self._state
     self._jax_key, key = jax.random.split(self._jax_key)

@@ -76,9 +76,27 @@ class CEMPolicyServer:
   def batcher(self) -> MicroBatcher:
     return self._batcher
 
-  def update_state(self, state: Any) -> None:
-    """Hot-swaps the acting params (checkpoint-refresh entry point)."""
-    self._engine.swap_state(state)
+  @property
+  def params_version(self) -> int:
+    """Monotonic params-publication counter (engine hot-swap count):
+    the policy-version stamp actors log per episode."""
+    return self._engine.params_version
+
+  @property
+  def params_learner_step(self) -> int:
+    """Learner step stamped on the currently-served params — the
+    `param_refresh_lag` reference point (docs/FLEET.md)."""
+    return self._engine.params_learner_step
+
+  def update_state(self, state: Any,
+                   learner_step: Optional[int] = None) -> None:
+    """Hot-swaps the acting params (checkpoint-refresh entry point).
+
+    `learner_step` stamps the refresh with the publisher's training
+    progress; fleets thread it through so every served action can be
+    attributed to the learner step its params came from.
+    """
+    self._engine.swap_state(state, learner_step=learner_step)
 
   def select_actions(self,
                      observations: Dict[str, np.ndarray]) -> np.ndarray:

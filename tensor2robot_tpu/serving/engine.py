@@ -27,12 +27,28 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, NamedTuple, Optional
 
 import jax
 import numpy as np
 
 from tensor2robot_tpu.serving import bucketing
+
+
+class _Published(NamedTuple):
+  """One atomically-published params generation.
+
+  The hot path reads this tuple with a single reference load, so the
+  state, its monotonic version, and the learner step it was published
+  at can never be observed mixed across a swap. `version` is the
+  counter fleets log per episode; `learner_step` is the
+  `param_refresh_lag` stamp (learner step the publisher trained to
+  when it pushed this tree; 0 for the construction-time params).
+  """
+
+  state: Any
+  version: int
+  learner_step: int
 
 # Process-wide count of engine bucket compiles — tests pin "zero
 # recompiles after warmup" against it alongside jax.monitoring events.
@@ -77,6 +93,10 @@ class BucketedServingEngine:
     placed = jax.device_put(state)
     jax.block_until_ready(placed)
     self._state = placed
+    # The versioned publication record; `_state` is kept in sync for
+    # introspection, but the hot path and the version/learner-step
+    # readers all go through this one reference.
+    self._published = _Published(placed, version=0, learner_step=0)
     # Buckets are LOWERED from these avals, never from the live state:
     # a concrete-state lower would key the (persistent) compile cache
     # on whatever tree `swap_state` last published, making a bucket
@@ -201,16 +221,41 @@ class BucketedServingEngine:
 
   # ---- params hot-swap ----
 
-  def swap_state(self, new_state: Any) -> None:
+  @property
+  def publication(self) -> _Published:
+    """The current (state, version, learner_step) publication as ONE
+    atomic read — callers that need version AND learner_step paired
+    (the fleet's per-episode lag stamp) must use this, not the two
+    scalar properties back to back (a swap between the reads would
+    tear the pair)."""
+    return self._published
+
+  @property
+  def params_version(self) -> int:
+    """Monotonic publication counter: 0 = construction-time params,
+    +1 per successful `swap_state`. The per-episode policy-version
+    stamp actor fleets log (the `param_refresh_lag` measurement seam)."""
+    return self._published.version
+
+  @property
+  def params_learner_step(self) -> int:
+    """Learner step stamped on the currently-published params."""
+    return self._published.learner_step
+
+  def swap_state(self, new_state: Any,
+                 learner_step: Optional[int] = None) -> None:
     """Publishes a fully-materialized new params tree (lock-free reads).
 
     The swap lock only serializes concurrent SWAPPERS (checkpoint
     poller vs. manual refresh); readers never take it — they grab the
-    current reference once per dispatch.
+    current reference once per dispatch. Each swap bumps the monotonic
+    `params_version`; `learner_step` stamps the publication with the
+    publisher's training progress (kept from the previous publication
+    when omitted, so non-learner swappers don't reset the lag clock).
     """
     with self._swap_lock:
       # Holding the lock across the transfer is intentional: only
-      # SWAPPERS contend here (the hot path reads `self._state`
+      # SWAPPERS contend here (the hot path reads the published tuple
       # lock-free), and overlapping transfers of two checkpoint trees
       # would waste device memory for no ordering benefit.
       # t2rcheck: disable=CON301
@@ -219,6 +264,12 @@ class BucketedServingEngine:
       # a half-transferred restore.
       # t2rcheck: disable=CON301
       jax.block_until_ready(placed)
+      previous = self._published
+      self._published = _Published(
+          placed,
+          version=previous.version + 1,
+          learner_step=(previous.learner_step if learner_step is None
+                        else int(learner_step)))
       self._state = placed
       self.swap_count += 1
 
@@ -235,7 +286,8 @@ class BucketedServingEngine:
       # taken after warmup() — the table is fully populated there.
       self._compile_bucket(bucket)
     padded = bucketing.pad_batch(features, bucket)
-    state = self._state  # one atomic read: old or new tree, never mixed
+    # One atomic read: old or new publication, never mixed.
+    state = self._published.state
     if self._takes_rng:
       outputs = self._compiled[bucket](state, padded, rng)
     else:

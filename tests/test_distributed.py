@@ -10,24 +10,23 @@ the loopback coordinator.
 """
 
 import os
-import socket
 import subprocess
 import sys
 from concurrent.futures import ThreadPoolExecutor
 
 import pytest
 
-
-def _free_port() -> int:
-  with socket.socket() as s:
-    s.bind(("127.0.0.1", 0))
-    return s.getsockname()[1]
+from tensor2robot_tpu.parallel.distributed import (
+    ephemeral_coordinator_address,
+)
 
 
 def test_two_process_cluster_runs_sharded_train_step(tmp_path):
   repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
   worker = os.path.join(repo, "tests", "distributed_worker.py")
-  coordinator = f"127.0.0.1:{_free_port()}"
+  # The coordinator-side port pick the fleet orchestrator uses too:
+  # bench + tests on one machine must never race on a fixed port.
+  coordinator = ephemeral_coordinator_address()
 
   # Scrub jax/tpu config the parent test session forced (cpu platform,
   # 8 fake devices): each worker sets its own.

@@ -1,0 +1,381 @@
+"""Fleet orchestrator tests: the failure paths ARE the product.
+
+The lifecycle contract of docs/FLEET.md, pinned:
+
+  * an actor crash mid-episode never lands partial rows (the staged
+    half-episode is aborted on disconnect, across the process
+    boundary);
+  * the restart policy respawns a crashed actor whose session reopen
+    discards stale staged state; the abort policy takes the fleet
+    down;
+  * learner death is detected and the actors exit;
+  * the shutdown barrier (normal AND after an injected crash) leaks
+    zero child processes and zero shm segments;
+  * a two-actor fleet runs end-to-end on CPU with the param
+    publication channel live (`param_refresh_lag` measured, policy
+    versions monotonic);
+  * fleet actor processes import WITHOUT jax (the Podracer actors-
+    are-cheap property).
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.fleet import (
+    Fleet,
+    FleetConfig,
+    FleetError,
+    RpcClient,
+    RpcError,
+    RpcServer,
+)
+from tensor2robot_tpu.fleet import host as host_lib
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tiny_config(**overrides) -> FleetConfig:
+  base = dict(
+      num_actors=2, env="toy_grasp", image_size=16, action_dim=2,
+      torso_filters=(8,), head_filters=(8,), dense_sizes=(16,),
+      cem_population=8, cem_iterations=1, cem_elites=2,
+      batch_size=16, max_train_steps=16, min_replay_size=32,
+      publish_every_steps=8, log_every_steps=8,
+      batch_episodes=8, serve_max_batch=4,
+      replay_capacity=512, replay_shards=1,
+      heartbeat_timeout_secs=0.0, launch_timeout_secs=240.0,
+      run_timeout_secs=420.0, seed=0)
+  base.update(overrides)
+  return FleetConfig(**base)
+
+
+def _shm_entries():
+  try:
+    return set(os.listdir("/dev/shm"))
+  except FileNotFoundError:  # non-Linux: nothing to pin
+    return set()
+
+
+def _assert_no_new_shm(before):
+  """Zero-shm-leak pin: once the fleet handle is released (callers
+  `del` their Fleet first — while it lives, its own stop Events /
+  heartbeat Values legitimately hold `sem.mp-*` entries), /dev/shm is
+  back to baseline. The contract is about what SURVIVES the fleet."""
+  import gc
+
+  gc.collect()
+  deadline = time.monotonic() + 10.0
+  while time.monotonic() < deadline:
+    if not _shm_entries() - before:
+      return
+    time.sleep(0.1)
+  assert _shm_entries() - before == set()
+
+
+def _fleet_children():
+  return [p for p in mp.active_children()
+          if p.name.startswith("t2r-fleet")]
+
+
+def _transitions(n=4, size=16):
+  return {
+      "image": np.zeros((n, size, size, 3), np.uint8),
+      "action": np.zeros((n, 2), np.float32),
+      "reward": np.ones((n, 1), np.float32),
+      "done": np.ones((n, 1), np.float32),
+      "next_image": np.zeros((n, size, size, 3), np.uint8),
+  }
+
+
+class TestRpc:
+  """Transport-level contract: errors travel, disconnects fire."""
+
+  def test_roundtrip_error_and_disconnect_callback(self):
+    seen = {"disconnects": 0}
+
+    def handler(method, payload, ctx):
+      if method == "echo":
+        ctx["n"] = ctx.get("n", 0) + 1
+        return {"payload": payload, "call": ctx["n"]}
+      if method == "boom":
+        raise ValueError("intentional")
+      if method == "__disconnect__":
+        seen["disconnects"] += 1
+        seen["calls_at_disconnect"] = ctx.get("n", 0)
+        return None
+      raise KeyError(method)
+
+    with RpcServer(handler, authkey=b"test") as server:
+      client = RpcClient(server.address, authkey=b"test")
+      assert client.call("echo", 1) == {"payload": 1, "call": 1}
+      assert client.call("echo", "x")["call"] == 2
+      with pytest.raises(RpcError, match="intentional"):
+        client.call("boom")
+      # The connection survives a handler error.
+      assert client.call("echo", None)["call"] == 3
+      client.close()
+      deadline = time.monotonic() + 5
+      while seen["disconnects"] == 0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert seen["disconnects"] == 1
+    assert seen["calls_at_disconnect"] == 3
+
+  def test_ephemeral_coordinator_addresses_are_distinct(self):
+    from tensor2robot_tpu.parallel.distributed import (
+        ephemeral_coordinator_address,
+    )
+
+    first = ephemeral_coordinator_address()
+    second = ephemeral_coordinator_address()
+    assert first.startswith("127.0.0.1:")
+    # Two concurrent launches (two fleets, bench + tests) must never
+    # be handed the same port.
+    assert first != second
+
+
+class TestParamsVersion:
+  """The hot-swap publication counter (the param_refresh_lag seam)."""
+
+  def test_engine_version_monotonic_and_learner_step_stamped(self):
+    import jax
+
+    from tensor2robot_tpu import specs
+    from tensor2robot_tpu.data.abstract_input_generator import Mode
+    from tensor2robot_tpu.serving import BucketedServingEngine
+    from tensor2robot_tpu.utils.mocks import MockT2RModel
+
+    model = MockT2RModel()
+    state = model.create_inference_state(jax.random.PRNGKey(0))
+    wire = specs.flatten_spec_structure(
+        model.preprocessor.get_in_feature_specification(Mode.PREDICT))
+    example = specs.make_random_tensors(wire, batch_size=1, seed=0)
+    engine = BucketedServingEngine(model.predict_step, state, example,
+                                   max_batch=2)
+    assert engine.params_version == 0
+    assert engine.params_learner_step == 0
+    engine.swap_state(state, learner_step=40)
+    assert engine.params_version == 1
+    assert engine.params_learner_step == 40
+    # A swap without a stamp keeps the previous learner step (a
+    # non-learner swapper must not reset the lag clock).
+    engine.swap_state(state)
+    assert engine.params_version == 2
+    assert engine.params_learner_step == 40
+    engine.swap_state(state, learner_step=80)
+    assert engine.params_version == 3
+    assert engine.params_learner_step == 80
+
+
+class TestPoseGraspBandit:
+  """The adapter that lets GraspActor drive the pose envs."""
+
+  def test_reset_grade_shapes_and_threshold(self):
+    from tensor2robot_tpu.research.pose_env.grasp_bandit import (
+        PoseGraspBandit,
+    )
+    from tensor2robot_tpu.research.pose_env.pose_env import (
+        WORKSPACE_HIGH,
+    )
+
+    bandit = PoseGraspBandit(image_size=16, physics=False, seed=3,
+                             success_threshold=0.1)
+    observations, poses = bandit.reset_batch(5)
+    assert observations["image"].shape == (5, 16, 16, 3)
+    assert observations["image"].dtype == np.uint8
+    assert poses.shape == (5, 2)
+    # A perfect grasp (the pose mapped back to [-1, 1]) succeeds; the
+    # far corner fails.
+    perfect = poses / WORKSPACE_HIGH
+    assert bandit.grade(perfect, poses).all()
+    miss = -np.sign(perfect) * np.ones_like(perfect)
+    assert bandit.grade(miss, poses).sum() == 0
+
+  def test_physics_variant_settles_poses(self):
+    from tensor2robot_tpu.research.pose_env.grasp_bandit import (
+        PoseGraspBandit,
+    )
+
+    bandit = PoseGraspBandit(image_size=16, physics=True, seed=5)
+    _, poses = bandit.reset_batch(2)
+    # Settled poses differ from the commanded drop (contact dynamics
+    # moved the block) — the physics is real, not a relabeled RNG.
+    assert not np.allclose(poses[-1], bandit.env.last_drop_pose)
+
+
+class TestActorImportClosure:
+
+  def test_actor_modules_import_without_jax(self):
+    # The Podracer actors-are-cheap property: everything a fleet actor
+    # process imports must stay jax-free (no XLA runtime per actor).
+    code = (
+        "import sys; "
+        "import tensor2robot_tpu.fleet.actor, "
+        "tensor2robot_tpu.fleet.rpc, tensor2robot_tpu.fleet.proc, "
+        "tensor2robot_tpu.research.qtopt.actor, "
+        "tensor2robot_tpu.research.qtopt.grasping_env, "
+        "tensor2robot_tpu.research.pose_env.grasp_bandit; "
+        "assert 'jax' not in sys.modules, 'jax leaked'; "
+        "print('JAXFREE')")
+    result = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=120, cwd=REPO)
+    assert result.returncode == 0, result.stderr
+    assert "JAXFREE" in result.stdout
+
+  def test_fleet_is_in_t2rcheck_concurrency_scope(self):
+    from tensor2robot_tpu.analysis import cli
+
+    assert "tensor2robot_tpu/fleet" in cli._CONCURRENCY_PATHS
+
+
+class TestHostSessionAbort:
+  """The mid-episode crash contract across the process boundary."""
+
+  @pytest.fixture(scope="class")
+  def host(self):
+    ctx = mp.get_context("spawn")
+    config = _tiny_config()
+    parent_conn, child_conn = ctx.Pipe()
+    stop = ctx.Event()
+    heartbeat = ctx.Value("d", 0.0)
+    process = ctx.Process(
+        target=host_lib.host_main,
+        args=(config, child_conn, stop, heartbeat),
+        name="t2r-fleet-host", daemon=True)
+    process.start()
+    child_conn.close()
+    assert parent_conn.poll(240.0), "host never reported ready"
+    address = tuple(parent_conn.recv()["address"])
+    parent_conn.close()
+    yield config, address
+    stop.set()
+    process.join(timeout=30.0)
+    if process.is_alive():
+      process.terminate()
+      process.join(5.0)
+    assert process.exitcode == 0
+
+  def test_dropped_connection_aborts_staged_episode(self, host):
+    config, address = host
+    actor = RpcClient(address, authkey=config.authkey)
+    actor.call("begin_episode", "actor-crashy")
+    actor.call("append", {"actor_id": "actor-crashy",
+                          "transitions": _transitions()})
+    # The actor process "dies" mid-episode: connection drops with the
+    # episode staged but never ended.
+    actor.close()
+
+    observer = RpcClient(address, authkey=config.authkey)
+    deadline = time.monotonic() + 10
+    aborted = 0.0
+    while time.monotonic() < deadline:
+      metrics = observer.call("metrics")
+      aborted = metrics["service"]["replay_aborted_episodes"]
+      if aborted >= 1.0:
+        break
+      time.sleep(0.05)
+    assert aborted >= 1.0
+    # Not one staged row landed.
+    assert observer.call("size") == 0
+    assert metrics["store"]["adds_total"] == 0.0
+
+    # A committed episode DOES land (the abort above was surgical) and
+    # carries the refresh-lag stamp.
+    committer = RpcClient(address, authkey=config.authkey)
+    payload = {"actor_id": "actor-ok", "transitions": _transitions(),
+               "policy_version": 0, "policy_learner_step": 0}
+    assert committer.call("commit", payload) is True
+    deadline = time.monotonic() + 10
+    while observer.call("size") < 4 and time.monotonic() < deadline:
+      time.sleep(0.05)
+    assert observer.call("size") == 4
+    assert observer.call("metrics")["param_refresh_lag"]["rows"] == 4
+    committer.close()
+    observer.close()
+
+
+class TestFleetLifecycle:
+  """Whole-topology runs: the expensive, load-bearing pins."""
+
+  def test_two_actor_smoke_end_to_end(self, tmp_path):
+    shm_before = _shm_entries()
+    # distributed_learner=True also exercises the collision-safe
+    # ephemeral-coordinator handoff end to end (a 1-process gloo
+    # cluster in the learner child).
+    config = _tiny_config(env="mujoco_pose", distributed_learner=True)
+    fleet = Fleet(config, str(tmp_path / "fleet"))
+    result = fleet.run()
+
+    assert result.clean_shutdown
+    assert result.metrics["store"]["adds_total"] > 0
+    assert result.env_steps_per_sec > 0
+    # The learner ran to max_train_steps and its rate was measured
+    # over the learner-step window.
+    assert result.metrics["learner_window"]["last_step"] == 16
+    assert result.learner_steps_per_sec > 0
+    # The publication channel was live: the final checkpoint publishes
+    # too, so >= 2 refreshes reached the serving engine, versions are
+    # monotonic, and committed rows carry lag attribution.
+    assert result.publishes >= 2
+    assert result.params_version == result.publishes
+    assert result.param_refresh_lag["rows"] > 0
+    assert result.param_refresh_lag["max"] >= 0
+    # The learner's training batches have a measured staleness
+    # distribution (ages in learner steps).
+    staleness = [s for s in result.replay_staleness.values() if s]
+    assert staleness and staleness[0]["rows"] > 0
+    # The shutdown barrier: no child processes, no shm segments.
+    assert _fleet_children() == []
+    del fleet
+    _assert_no_new_shm(shm_before)
+
+  def test_actor_crash_restart_lands_no_partial_rows(self, tmp_path):
+    shm_before = _shm_entries()
+    config = _tiny_config(
+        actor_crash_after_episodes=2, actor_crash_mode="mid_episode",
+        crash_actor_index=0, max_actor_restarts=2)
+    fleet = Fleet(config, str(tmp_path / "fleet"))
+    result = fleet.run()
+
+    service = result.metrics["service"]
+    # The crash was real (the orchestrator restarted the actor), the
+    # reopen aborted the staged half-episode, and every row that DID
+    # land arrived in whole batch_episodes-sized commits — a partial
+    # episode would break the divisibility.
+    assert result.actor_restarts >= 1
+    assert service["replay_actor_restarts"] >= 1.0
+    assert service["replay_aborted_episodes"] >= 1.0
+    assert result.metrics["store"]["adds_total"] % config.batch_episodes == 0
+    assert result.clean_shutdown
+    assert _fleet_children() == []
+    del fleet
+    _assert_no_new_shm(shm_before)
+
+  def test_learner_death_detected_and_actors_exit(self, tmp_path):
+    shm_before = _shm_entries()
+    config = _tiny_config(learner_crash_after_steps=4)
+    fleet = Fleet(config, str(tmp_path / "fleet"))
+    with pytest.raises(FleetError, match="learner died"):
+      fleet.run()
+    # The abort teardown stopped every actor and the host — crash
+    # shutdown leaks nothing either.
+    assert _fleet_children() == []
+    del fleet
+    _assert_no_new_shm(shm_before)
+
+  def test_actor_abort_policy_takes_fleet_down(self, tmp_path):
+    config = _tiny_config(
+        actor_crash_after_episodes=1, actor_crash_mode="hard",
+        actor_crash_policy="abort")
+    fleet = Fleet(config, str(tmp_path / "fleet"))
+    with pytest.raises(FleetError, match="actor 0 died"):
+      fleet.run()
+    assert _fleet_children() == []
