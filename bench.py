@@ -107,6 +107,20 @@ Usage: python bench.py [--paper] [--profile DIR] [--input] [--replay]
              staleness the learner actually trained on. With
              --dry-run: tiny model, short run, no BENCH_DETAIL.json
              write — the tier-1 smoke.
+  --envs     the on-device vectorized-env axis (envs section):
+             env-steps/s of the Anakin rollout engine (envs/ — CEM
+             acting at the committed fleet axis's config) vs num_envs
+             (64/256/1024), as one jitted program AND as the full
+             Anakin topology (vmap envs × pmap devices — virtual
+             8-device mesh on CPU hosts, the --pipeline precedent,
+             subprocessed in scripts/envs_bench.py), plus the
+             random-policy stepping ceiling, the --trainer=anakin
+             collect+train interleaved rate (param_refresh_lag 0 by
+             construction), and the host-vs-device pose parity pin
+             (matched-geometry rewards + bitwise noise-0 frames);
+             speedup vs the committed fleet env_steps_per_sec
+             baseline recorded. With --dry-run: tiny env/model, no
+             BENCH_DETAIL.json write — the tier-1 smoke.
   --serving  the low-latency serving axis (serving_latency section):
              CEM action-selection latency at batch=1 and batch=8
              through the bucketed AOT engine (p50/p95 over ≥100
@@ -1693,6 +1707,51 @@ def bench_fleet(dry_run: bool = False):
   }
 
 
+def bench_envs(dry_run: bool = False):
+  """The --envs axis: on-device vectorized env rollouts (docs/ENVS.md).
+
+  Subprocessed (scripts/envs_bench.py, the --pipeline precedent): on a
+  CPU host the child presents the 8-virtual-device mesh so the Anakin
+  scale-out row (vmap envs INSIDE pmap devices — Podracer's topology
+  verbatim) measures the machine, not XLA:CPU's single-program
+  intra-op ceiling; on a chip host the child sees the local devices
+  and the same code pmaps over them. The acting config matches the
+  committed fleet axis (same CEM tower, same observation size), so
+  `env_steps_per_sec` compares against `fleet.env_steps_per_sec`
+  apples-to-apples — that comparison is appended by main() from the
+  committed detail file.
+  """
+  import subprocess
+
+  script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "scripts", "envs_bench.py")
+  env = dict(os.environ)
+  env["PYTHONPATH"] = (os.path.dirname(script) + "/.." + os.pathsep
+                       + env.get("PYTHONPATH", ""))
+  # Branch on the ENV VAR, not jax.default_backend(): probing the
+  # backend would initialize the accelerator runtime IN THE PARENT,
+  # and on a chip host the child — which must own the (single-process
+  # -exclusive) device for the pmap axis — could then no longer
+  # acquire it. CPU runs in this repo always say so explicitly
+  # (tier1.sh / the committed runs set JAX_PLATFORMS=cpu); anything
+  # else passes through untouched so the child sees the chips.
+  if env.get("JAX_PLATFORMS", "").split(",")[0] == "cpu":
+    # The Anakin pmap axis on a chipless host: the virtual CPU mesh
+    # (tests/conftest.py's idiom).
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+      env["XLA_FLAGS"] = (
+          flags + " --xla_force_host_platform_device_count=8").strip()
+  out = subprocess.run(
+      [sys.executable, script] + (["--dry-run"] if dry_run else []),
+      env=env, capture_output=True, text=True, timeout=2400)
+  if out.returncode != 0:
+    sys.stderr.write(out.stderr)
+    raise SystemExit(
+        f"envs bench subprocess failed ({out.returncode})")
+  return json.loads(out.stdout.strip().splitlines()[-1])
+
+
 def bench_coldstart(dry_run: bool = False):
   """The restart-latency axis: cold-cache vs warm-cache subprocesses.
 
@@ -2244,6 +2303,28 @@ def main():
         "clean_shutdown": smoke["clean_shutdown"],
     }))
     return
+  if "--envs" in args and "--dry-run" in args:
+    # Tier-1 smoke of the on-device envs bench path: tiny env/model,
+    # the full subprocess topology (virtual mesh, pmap scale-out,
+    # interleaved trainer, parity pin), NO detail-file write.
+    smoke = bench_envs(dry_run=True)
+    scaleout = smoke.get("anakin_scaleout") or {}
+    print(json.dumps({
+        "envs_dry_run": "ok",
+        "devices": smoke["devices"],
+        "rollout_env_steps_per_sec": {
+            n: row["env_steps_per_sec"]
+            for n, row in smoke["rollout_env_steps_per_sec"].items()},
+        "scaleout_env_steps_per_sec":
+            scaleout.get("env_steps_per_sec"),
+        "param_refresh_lag_steps":
+            smoke["train_interleaved"]["param_refresh_lag_steps"],
+        "pose_parity_reward_max_abs_diff":
+            smoke["pose_parity"]["reward_max_abs_diff"],
+        "pose_parity_image_bitwise":
+            smoke["pose_parity"]["image_bitwise_equal_noise0"],
+    }))
+    return
   if "--serving" in args and "--dry-run" in args:
     # Tier-1 smoke of the serving bench path: tiny model, one small
     # bucket table, local backend, NO detail-file write (a CPU smoke
@@ -2299,7 +2380,7 @@ def main():
   axis_flags = {"--input", "--replay", "--replayfeed", "--longcontext",
                 "--podscale", "--moe", "--pipeline", "--verify",
                 "--serving", "--coldstart", "--mxu", "--mfu",
-                "--fleet"}
+                "--fleet", "--envs"}
   axis_only = (bool(args) and not run_paper and profile_dir is None
                and "--primary" not in args
                and all(a in axis_flags for a in args))
@@ -2386,6 +2467,26 @@ def main():
     detail["serving_latency"] = bench_serving()
   if "--fleet" in args:
     detail["fleet"] = bench_fleet()
+  if "--envs" in args:
+    section = bench_envs()
+    # The ISSUE-9 verdict: on-device rollout vs the committed fleet
+    # data plane, same acting config. Headline = the Anakin topology
+    # (vmap envs × pmap devices); the single-program jit row rides
+    # along with its measured core ceiling.
+    fleet_baseline = (detail.get("fleet") or {}).get(
+        "env_steps_per_sec")
+    if fleet_baseline:
+      scaleout = section.get("anakin_scaleout") or {}
+      top = str(max(int(n) for n in
+                    section["rollout_env_steps_per_sec"]))
+      single = section["rollout_env_steps_per_sec"][top]
+      section["fleet_baseline_env_steps_per_sec"] = fleet_baseline
+      if scaleout.get("env_steps_per_sec"):
+        section["speedup_vs_fleet"] = round(
+            scaleout["env_steps_per_sec"] / fleet_baseline, 1)
+      section["speedup_vs_fleet_single_program"] = round(
+          single["env_steps_per_sec"] / fleet_baseline, 1)
+    detail["envs"] = section
   if "--coldstart" in args:
     detail["coldstart"] = bench_coldstart()
   if "--mfu" in args:

@@ -1,0 +1,266 @@
+"""On-device vectorized-env rollout measurements (bench.py --envs).
+
+Run in a SUBPROCESS by `bench.py` (the --pipeline precedent) so the
+CPU backend can present the 8-virtual-device mesh: Anakin's topology
+is vmap-over-envs INSIDE pmap-over-devices (Podracer, PAPERS.md) — on
+a TPU host the pmap axis is the local chips; on CPU the virtual mesh
+stands in, and it matters beyond fidelity: one jitted rollout program
+hits XLA:CPU's intra-op parallelism ceiling (~8 busy cores of 24 on
+the committed host) while the pmap'd twin saturates the machine.
+
+Methodology:
+  * Acting config matches the committed fleet axis (qtopt_fleet.gin's
+    tower: 32×32 obs, torso (16,32), head (32,32), dense (32,32),
+    bf16, CEM 64×2, ε=0.1) so env-steps/s compares to the fleet
+    baseline apples-to-apples — same policy compute per env-step,
+    same observation size.
+  * Every number is D2H-barriered (`float(sum)`), best of N trials,
+    trials recorded.
+  * `pose_parity` is the host-vs-device pin: rewards on MATCHED
+    geometry (poses taken from the host env) must agree exactly, and
+    the rendered frame at noise=0 must be bitwise equal.
+
+Prints one JSON object on the last stdout line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+TRIALS = 5
+
+
+def _timed_collect(collect, state, env_states, key_base, steps_per_call,
+                   trials=TRIALS):
+  """Best-of-N env-steps/s with the D2H barrier; returns (best, rates,
+  cores_used_first_trial, env_states)."""
+  import jax
+
+  rates = []
+  cores = None
+  for t in range(trials):
+    c0 = time.process_time()
+    t0 = time.perf_counter()
+    env_states, batch = collect(state, env_states,
+                                jax.random.fold_in(key_base, t))
+    float(batch["reward"].sum())
+    dt = time.perf_counter() - t0
+    if cores is None:
+      cores = round((time.process_time() - c0) / dt, 1)
+    rates.append(steps_per_call / dt)
+  return max(rates), [round(r, 1) for r in rates], cores, env_states
+
+
+def _pose_parity(image_size: int, episodes: int):
+  """Host `PoseGraspBandit` vs `envs.pose` on matched geometry."""
+  import jax
+  import jax.numpy as jnp
+  import numpy as np
+
+  from tensor2robot_tpu.envs import PoseBanditEnv, host_parity_env
+  from tensor2robot_tpu.research.pose_env.grasp_bandit import (
+      PoseGraspBandit,
+  )
+
+  host = PoseGraspBandit(image_size=image_size, physics=False, seed=7,
+                         noise=0.0)
+  device = host_parity_env(host)
+  _, poses = host.reset_batch(episodes)
+  actions = np.random.default_rng(11).uniform(
+      -1, 1, (episodes, 2)).astype(np.float32)
+  host_rewards = host.grade(actions, poses)
+  device_rewards = np.asarray(jax.device_get(jax.jit(jax.vmap(
+      device.grasp_reward))(jnp.asarray(actions), jnp.asarray(poses))))
+  # Bitwise frame parity at noise=0 (sensor noise is the one
+  # legitimately-different stream between the two RNGs).
+  noiseless = PoseBanditEnv(image_size=image_size, noise=0.0)
+  host.env._pose = poses[0]
+  host_frame = host.env._observation()["image"]
+  device_frame = np.asarray(jax.device_get(noiseless.observe(
+      noiseless.state_at(poses[0], jax.random.PRNGKey(0)))["image"]))
+  return {
+      "episodes": episodes,
+      "reward_max_abs_diff": float(
+          np.abs(host_rewards - device_rewards).max()),
+      "reward_match_rate": float(
+          (host_rewards == device_rewards).mean()),
+      "image_bitwise_equal_noise0": bool(
+          np.array_equal(host_frame, device_frame)),
+  }
+
+
+def main() -> None:
+  dry_run = "--dry-run" in sys.argv[1:]
+  import jax
+  import jax.numpy as jnp
+
+  from tensor2robot_tpu.envs import (
+      PoseBanditEnv,
+      make_anakin_collect_fn,
+      make_batched,
+      make_collect_fn,
+  )
+  from tensor2robot_tpu.envs.rollout import rollout
+  from tensor2robot_tpu.research.qtopt import (
+      GraspingQModel,
+      QTOptLearner,
+  )
+
+  devices = jax.local_devices()
+  if dry_run:
+    image, torso, head, dense = 16, (8,), (8,), (16,)
+    population, iterations, elites = 8, 1, 2
+    env_counts = (16,)
+    scaleout_envs = 16
+    length = 4
+    parity_episodes = 32
+  else:
+    # The committed fleet axis's acting config (qtopt_fleet.gin).
+    image, torso, head, dense = 32, (16, 32), (32, 32), (32, 32)
+    population, iterations, elites = 64, 2, 6
+    env_counts = (64, 256, 1024)
+    scaleout_envs = 1024
+    length = 32
+    parity_episodes = 256
+
+  env = PoseBanditEnv(image_size=image, action_dim=2)
+  model = GraspingQModel(image_size=image, action_dim=2,
+                         torso_filters=torso, head_filters=head,
+                         dense_sizes=dense)
+  learner = QTOptLearner(model, cem_population=population,
+                         cem_iterations=iterations, cem_elites=elites)
+  state = learner.create_state(jax.random.PRNGKey(0))
+
+  # --- single-program (jit) rollout curve: env-steps/s vs num_envs ---
+  curve = {}
+  for n in env_counts:
+    init_fn, collect_fn = make_collect_fn(
+        learner, env, n, length, epsilon=0.1)
+    env_states = jax.jit(init_fn)(jax.random.PRNGKey(1))
+    collect = jax.jit(collect_fn, donate_argnums=(1,))
+    t0 = time.perf_counter()
+    env_states, batch = collect(state, env_states,
+                                jax.random.PRNGKey(2))
+    float(batch["reward"].sum())
+    compile_secs = time.perf_counter() - t0
+    best, rates, cores, env_states = _timed_collect(
+        collect, state, env_states, jax.random.PRNGKey(3), n * length)
+    curve[str(n)] = {
+        "env_steps_per_sec": round(best, 1),
+        "trials": rates,
+        "cores_used": cores,
+        "compile_secs": round(compile_secs, 1),
+    }
+
+  # --- the Anakin topology: vmap envs inside pmap devices ---
+  scaleout = None
+  if scaleout_envs % len(devices) == 0:
+    init_fn, collect_fn = make_anakin_collect_fn(
+        learner, env, scaleout_envs, length, epsilon=0.1,
+        devices=devices)
+    env_states = init_fn(jax.random.PRNGKey(4))
+    env_states, batch = collect_fn(state, env_states,
+                                   jax.random.PRNGKey(5))
+    float(batch["reward"].sum())
+    best, rates, cores, env_states = _timed_collect(
+        collect_fn, state, env_states, jax.random.PRNGKey(6),
+        scaleout_envs * length)
+    scaleout = {
+        "num_envs": scaleout_envs,
+        "devices": len(devices),
+        "envs_per_device": scaleout_envs // len(devices),
+        "env_steps_per_sec": round(best, 1),
+        "trials": rates,
+        "cores_used": cores,
+    }
+
+  # --- random-policy ceiling: pure env stepping, no CEM tower ---
+  n = max(env_counts)
+  batched = make_batched(env, n)
+
+  def random_policy(obs, key):
+    del obs
+    return jax.random.uniform(key, (n, 2), minval=-1.0, maxval=1.0)
+
+  def random_collect(_, env_states, key):
+    env_states, traj = rollout(batched, random_policy, env_states,
+                               key, length)
+    return env_states, traj
+
+  env_states = jax.jit(batched.reset)(jax.random.PRNGKey(7))
+  random_collect = jax.jit(random_collect, donate_argnums=(1,))
+  env_states, traj = random_collect(state, env_states,
+                                    jax.random.PRNGKey(8))
+  float(traj["reward"].sum())
+  best, rates, _, _ = _timed_collect(
+      random_collect, state, env_states, jax.random.PRNGKey(9),
+      n * length, trials=3)
+  random_ceiling = {"num_envs": n, "env_steps_per_sec": round(best, 1),
+                    "trials": rates}
+
+  # --- collect+train interleaved: the --trainer=anakin iteration ---
+  import tempfile
+
+  from tensor2robot_tpu.envs import train_anakin
+
+  with tempfile.TemporaryDirectory() as tmp:
+    if dry_run:
+      kwargs = dict(num_envs=16, rollout_length=2,
+                    train_batches_per_iter=2, batch_size=16,
+                    replay_capacity=128, max_train_steps=8,
+                    log_every_steps=4, save_checkpoints_steps=8)
+    else:
+      kwargs = dict(num_envs=1024, rollout_length=4,
+                    train_batches_per_iter=4, batch_size=256,
+                    replay_capacity=16384, max_train_steps=96,
+                    log_every_steps=32, save_checkpoints_steps=96)
+    train_anakin(learner=learner, model_dir=tmp, env=env, seed=0,
+                 **kwargs)
+    rows = [json.loads(line)
+            for line in open(os.path.join(tmp, "metrics_train.jsonl"))]
+  last = rows[-1]
+  interleaved = {
+      "num_envs": kwargs["num_envs"],
+      "rollout_length": kwargs["rollout_length"],
+      "train_batches_per_iter": kwargs["train_batches_per_iter"],
+      "env_steps_per_sec": round(last["env_steps_per_sec"], 1),
+      "grad_steps_per_sec": round(last["grad_steps_per_sec"], 2),
+      "param_refresh_lag_steps": last["param_refresh_lag_steps"],
+      "note": ("one jitted program per iteration: rollout segment + "
+               "device replay-ring insert + K Bellman grad steps; "
+               "lag is zero by construction"),
+  }
+
+  result = {
+      "device_kind": devices[0].device_kind,
+      "backend": jax.default_backend(),
+      "devices": len(devices),
+      "host_cores": os.cpu_count(),
+      "acting_config": (
+          f"{image}x{image} uint8 obs, tower {torso}/{head}/{dense} "
+          f"bf16, CEM {iterations}x{population} eps=0.1 — the "
+          "committed fleet axis's acting config"),
+      "rollout_length_per_dispatch": length,
+      "rollout_env_steps_per_sec": curve,
+      "anakin_scaleout": scaleout,
+      "random_policy_ceiling": random_ceiling,
+      "train_interleaved": interleaved,
+      "pose_parity": _pose_parity(image, parity_episodes),
+      "note": (
+          "env-steps/s counts collected transitions (auto-reset "
+          "rollouts, CEM acting unless noted); the single-program jit "
+          "curve shows XLA:CPU's intra-op ceiling, the pmap scale-out "
+          "row is the Anakin topology (vmap envs x pmap devices) the "
+          "same code runs on TPU chips"),
+  }
+  print(json.dumps(result))
+
+
+if __name__ == "__main__":
+  main()

@@ -17,6 +17,9 @@ Usage:
                                                   # bound through the
                                                   # device tunnel)
   python scripts/run_success_protocol.py online   # offline→online
+  python scripts/run_success_protocol.py envs     # on-device anakin
+                                                  # train + procedural
+                                                  # scenario sweep
   python scripts/run_success_protocol.py seedcheck  # reproducibility
                                                   # dry run (CPU-ok)
 
@@ -275,6 +278,99 @@ def run_qtopt_online(tmp: str) -> None:
         {"records": len(records) + 1, "last": summary})
 
 
+def run_envs(tmp: str) -> None:
+  """Envs-family robustness protocol: Anakin-trained QT-Opt scored on
+  a seeded PROCEDURAL scenario sweep, success per scenario bucket.
+
+  The scenario source is `ProcGenGraspEnv` (tensor2robot_tpu/envs/):
+  every PRNG key samples fresh geometry/dynamics — workspace scale,
+  block size, sensor noise, distractor count, drift — so the sweep is
+  a randomized robustness eval with unlimited variation, not a replay
+  of a fixed episode set. Training runs `--trainer=anakin`'s
+  fully-on-device loop (collection and Bellman updates in one jitted
+  program, zero param-refresh lag); the 512-scenario sweep
+  (`evaluate_scenarios`) then groups success by distractor count, with
+  the random-policy baseline on the SAME scenarios for scale. All
+  stochastic inputs derive from PROTOCOL_SEED; the sweep's
+  action/scenario digests are the reproducibility handles `seedcheck`
+  pins.
+  """
+  from tensor2robot_tpu.envs import (
+      ProcGenGraspEnv,
+      evaluate_scenarios,
+      train_anakin,
+  )
+  from tensor2robot_tpu.models import optimizers as opt_lib
+  from tensor2robot_tpu.research.qtopt import (
+      GraspingQModel,
+      QTOptLearner,
+  )
+
+  model = GraspingQModel(
+      image_size=32, action_dim=2,
+      torso_filters=(16, 32), head_filters=(32, 32),
+      dense_sizes=(32, 32),
+      create_optimizer_fn=lambda: opt_lib.create_optimizer(
+          learning_rate=1e-3))
+  learner = QTOptLearner(model, cem_population=64, cem_iterations=2,
+                         cem_elites=6)
+  env = ProcGenGraspEnv(image_size=32, action_dim=2)
+
+  model_dir = os.path.join(tmp, "qtopt_envs")
+  state = train_anakin(
+      learner=learner,
+      model_dir=model_dir,
+      env=env,
+      num_envs=256,
+      rollout_length=4,
+      train_batches_per_iter=4,
+      batch_size=256,
+      replay_capacity=16384,
+      max_train_steps=2000,
+      log_every_steps=200,
+      save_checkpoints_steps=500,
+      epsilon=0.1,
+      seed=PROTOCOL_SEED,
+  )
+
+  sweep = evaluate_scenarios(learner, state, env=env,
+                             num_scenarios=512,
+                             seed=PROTOCOL_SEED + 5,
+                             cem_population=64, cem_iterations=3)
+  train_records = [json.loads(line) for line in
+                   open(os.path.join(model_dir, "metrics_train.jsonl"))]
+  records = []
+  for bucket, stats in sorted(sweep["per_bucket"].items()):
+    records.append({"scenario_bucket": bucket,
+                    "distractors": int(bucket), **stats})
+  summary = {
+      "phase": "summary",
+      "scenario_family": "procgen",
+      "success_rate": sweep["success_rate"],
+      "random_baseline_success_rate":
+          sweep["random_baseline_success_rate"],
+      "num_scenarios": sweep["num_scenarios"],
+      "action_digest": sweep["action_digest"],
+      "scenario_digest": sweep["scenario_digest"],
+      "train_steps": train_records[-1]["step"],
+      "final_collect_reward_mean":
+          train_records[-1]["collect_reward_mean"],
+      "env_steps_per_sec_last": train_records[-1]["env_steps_per_sec"],
+      "param_refresh_lag_steps": 0.0,
+      "note": ("trained fully on device (--trainer=anakin): the "
+               "collection policy reads the current learner params "
+               "inside the training program, so lag is structural "
+               "zero; scenario buckets = distractor count"),
+  }
+  os.makedirs(ARTIFACTS, exist_ok=True)
+  dst = os.path.join(ARTIFACTS, "qtopt_envs_scenarios.jsonl")
+  with open(dst, "w") as f:
+    for r in records + [summary]:
+      f.write(json.dumps(r) + "\n")
+  _emit("qtopt_envs_scenarios.jsonl",
+        {"records": len(records) + 1, "last": summary})
+
+
 def run_seedcheck(tmp: str) -> None:
   """Reproducibility dry run: the online plane, twice, must match.
 
@@ -338,9 +434,35 @@ def run_seedcheck(tmp: str) -> None:
         "episodes": actor.episodes_collected,
     }
 
+  def envs_pass():
+    # The envs-family half of the protocol (ISSUE 9): the procedural
+    # scenario sweep must reproduce its scenario AND action digests
+    # bit-for-bit from PROTOCOL_SEED — scenarios are pure functions of
+    # keys, so any divergence means an unseeded input crept in.
+    import jax
+
+    from tensor2robot_tpu.envs import ProcGenGraspEnv, evaluate_scenarios
+
+    model = GraspingQModel(image_size=16, torso_filters=(8,),
+                           head_filters=(8,), dense_sizes=(16,),
+                           action_dim=2)
+    learner = QTOptLearner(model, cem_population=8, cem_iterations=1,
+                           cem_elites=2)
+    state = learner.create_state(jax.random.PRNGKey(PROTOCOL_SEED))
+    sweep = evaluate_scenarios(
+        learner, state,
+        env=ProcGenGraspEnv(image_size=16, action_dim=2),
+        num_scenarios=64, seed=PROTOCOL_SEED)
+    return {"scenario_sweep_action_sha256": sweep["action_digest"],
+            "scenario_sweep_scenario_sha256": sweep["scenario_digest"]}
+
   a, b = one_pass(), one_pass()
+  ea, eb = envs_pass(), envs_pass()
+  a.update(ea)
+  b.update(eb)
   ok = (a["sample_schedule_sha256"] == b["sample_schedule_sha256"]
-        and a["action_stream_sha256"] == b["action_stream_sha256"])
+        and a["action_stream_sha256"] == b["action_stream_sha256"]
+        and ea == eb)
   print(json.dumps({"artifact": "seedcheck", "reproducible": ok,
                     "run_a": a, "run_b": b}))
   if not ok:
@@ -442,10 +564,12 @@ def run_gripper(tmp: str) -> None:
 def main():
   mode = sys.argv[1] if len(sys.argv) > 1 else ""
   runners = {"qtopt": run_qtopt, "gripper": run_gripper,
-             "online": run_qtopt_online, "seedcheck": run_seedcheck}
+             "online": run_qtopt_online, "envs": run_envs,
+             "seedcheck": run_seedcheck}
   if mode not in runners:
     raise SystemExit(
-        "usage: run_success_protocol.py {qtopt|gripper|online|seedcheck}")
+        "usage: run_success_protocol.py "
+        "{qtopt|gripper|online|envs|seedcheck}")
   if mode == "gripper":
     # Serving loops dispatch per step; host CPU avoids tunnel latency.
     os.environ["JAX_PLATFORMS"] = "cpu"

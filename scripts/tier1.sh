@@ -43,10 +43,15 @@ echo "--- fleet bench smoke (bench.py --fleet --dry-run) ---"
 env JAX_PLATFORMS=cpu python bench.py --fleet --dry-run
 fleet_rc=$?
 
+echo "--- envs bench smoke (bench.py --envs --dry-run) ---"
+env JAX_PLATFORMS=cpu python bench.py --envs --dry-run
+envs_rc=$?
+
 if [ "$rc" -ne 0 ]; then exit "$rc"; fi
 if [ "$smoke_rc" -ne 0 ]; then exit "$smoke_rc"; fi
 if [ "$coldstart_rc" -ne 0 ]; then exit "$coldstart_rc"; fi
 if [ "$replay_rc" -ne 0 ]; then exit "$replay_rc"; fi
 if [ "$input_rc" -ne 0 ]; then exit "$input_rc"; fi
 if [ "$mfu_rc" -ne 0 ]; then exit "$mfu_rc"; fi
-exit "$fleet_rc"
+if [ "$fleet_rc" -ne 0 ]; then exit "$fleet_rc"; fi
+exit "$envs_rc"

@@ -29,11 +29,14 @@ _PACKAGE_DIR = os.path.dirname(os.path.dirname(os.path.abspath(
 REPO_ROOT = os.path.dirname(_PACKAGE_DIR)
 
 # Default scan scope per family. The concurrency family covers the
-# five subsystems the lock-order graph is specified over (ISSUE 5;
+# subsystems the lock-order graph is specified over (ISSUE 5;
 # fleet added by ISSUE 8 — the orchestrator's process/thread
-# lifecycle lands with zero pragmas, baseline stays empty);
+# lifecycle lands with zero pragmas, baseline stays empty;
+# envs added by ISSUE 9 — pure functional code, so CON findings there
+# would mean the purity contract broke);
 # jax covers the whole package (traced code lives everywhere: models,
-# ops, parallel, research).
+# ops, parallel, research — and the envs family is scanned code by
+# construction: envs ARE traced functions).
 _JAX_PATHS = ("tensor2robot_tpu",)
 _CONCURRENCY_PATHS = (
     "tensor2robot_tpu/replay",
@@ -41,6 +44,7 @@ _CONCURRENCY_PATHS = (
     "tensor2robot_tpu/data",
     "tensor2robot_tpu/startup",
     "tensor2robot_tpu/fleet",
+    "tensor2robot_tpu/envs",
 )
 _GIN_PATHS = ("tensor2robot_tpu",)
 

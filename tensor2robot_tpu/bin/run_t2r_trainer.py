@@ -50,14 +50,17 @@ flags.DEFINE_bool(
     "Force jax.distributed.initialize() even without an explicit "
     "coordinator (TPU pod auto-discovery).")
 flags.DEFINE_enum(
-    "trainer", "train_eval", ["train_eval", "qtopt", "fleet"],
+    "trainer", "train_eval", ["train_eval", "qtopt", "fleet",
+                              "anakin"],
     "Entry to run after gin parsing: the supervised "
     "train_eval_model() loop (default), the QT-Opt learner loop "
     "(train_qtopt — configs binding train_qtopt.*, e.g. "
-    "research/qtopt/configs/qtopt_int8.gin), or the multi-process "
+    "research/qtopt/configs/qtopt_int8.gin), the multi-process "
     "learner/actor fleet (run_fleet — configs binding run_fleet.* / "
     "FleetConfig.*, e.g. research/qtopt/configs/qtopt_fleet.gin; "
-    "docs/FLEET.md).")
+    "docs/FLEET.md), or the fully-on-device Anakin online mode "
+    "(train_anakin — configs binding train_anakin.*, e.g. "
+    "research/qtopt/configs/qtopt_anakin.gin; docs/ENVS.md).")
 
 # Configurable registration happens at import; pull in every in-tree
 # family so configs can reference them without import lines.
@@ -70,6 +73,7 @@ _DEFAULT_MODULES = (
     "tensor2robot_tpu.hooks",
     "tensor2robot_tpu.meta_learning",
     "tensor2robot_tpu.fleet",
+    "tensor2robot_tpu.envs",
     "tensor2robot_tpu.research.grasp2vec",
     "tensor2robot_tpu.research.pose_env",
     "tensor2robot_tpu.research.qtopt",
@@ -121,6 +125,9 @@ def main(argv):
     # as its pre-spawn launch gate (docs/FLEET.md).
     from tensor2robot_tpu.fleet import run_fleet
     run_fleet(gin_configs=configs)
+  elif FLAGS.trainer == "anakin":
+    from tensor2robot_tpu.envs import train_anakin
+    train_anakin()
   else:
     train_eval.train_eval_model()
 

@@ -1,0 +1,63 @@
+"""On-device vectorized environments (docs/ENVS.md).
+
+JAX-native envs as pure functions over PRNG keys (envs/core.py), the
+pose/grasp bandit and procedural-scenario families (envs/pose.py,
+envs/procgen.py), and the Anakin-style rollout engine + `--trainer=
+anakin` online mode (envs/rollout.py).
+
+Exports resolve LAZILY (PEP 562, the `data/__init__` pattern): every
+submodule imports jax, and processes that only validate configs or
+speak RPC must not pay the XLA runtime for touching the package.
+Gin registration stays eager-enough via `register_lazy_configurables`
+— the first config reference imports the defining submodule.
+"""
+
+from tensor2robot_tpu import config as _gin
+
+_EXPORTS = {
+    "AutoResetEnv": "core",
+    "BatchedEnv": "core",
+    "FunctionalEnv": "core",
+    "select_state": "core",
+    "PoseBanditEnv": "pose",
+    "PoseState": "pose",
+    "host_parity_env": "pose",
+    "ProcGenGraspEnv": "procgen",
+    "ProcGenState": "procgen",
+    # NOTE: the `rollout` FUNCTION is deliberately not re-exported —
+    # importing the `envs.rollout` submodule binds the package
+    # attribute `rollout` to the MODULE (normal Python submodule
+    # semantics), which would shadow a same-named lazy export
+    # order-dependently. Use `envs.rollout.rollout` directly.
+    "JaxEnvBandit": "rollout",
+    "evaluate_scenarios": "rollout",
+    "flatten_devices": "rollout",
+    "flatten_time": "rollout",
+    "make_anakin_collect_fn": "rollout",
+    "make_batched": "rollout",
+    "make_collect_fn": "rollout",
+    "train_anakin": "rollout",
+}
+
+__all__ = sorted(_EXPORTS)
+
+for _name, _mod in (("PoseBanditEnv", "pose"),
+                    ("ProcGenGraspEnv", "procgen"),
+                    ("JaxEnvBandit", "rollout"),
+                    ("evaluate_scenarios", "rollout"),
+                    ("train_anakin", "rollout")):
+  _gin.register_lazy_configurables(f"{__name__}.{_mod}", (_name,))
+del _name, _mod
+
+
+def __getattr__(name):
+  module_name = _EXPORTS.get(name)
+  if module_name is None:
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
+  import importlib
+
+  module = importlib.import_module(f"{__name__}.{module_name}")
+  value = getattr(module, name)
+  globals()[name] = value
+  return value

@@ -1,0 +1,152 @@
+"""Functional environment API: envs as pure functions over PRNG keys.
+
+The Anakin lesson (Podracer, PAPERS.md arXiv:2104.06272): when an
+environment is a pure jit/vmap-able function, the whole actor loop —
+observe → act → step — compiles into ONE device program, so thousands
+of parallel envs run inside a single `lax.scan` with no host data
+plane at all. The JaxARC corollary (PAPERS.md): the same purity makes
+every PRNG key a fresh scenario, so the env family doubles as an
+infinite procedural generator for robustness evals.
+
+The contract (docs/ENVS.md):
+
+  * ``EnvState`` — a pytree (any flax.struct.dataclass) holding
+    EVERYTHING episode-specific: task geometry, step counter, the
+    noise key observations derive from. No Python-side state.
+  * ``reset(key) -> EnvState`` — samples a fresh episode from the key
+    alone. Same key, same episode, bit-for-bit.
+  * ``observe(state) -> {name: array}`` — renders the observation the
+    policy acts on. Pure in the state (the per-episode noise key lives
+    IN the state, so observe is deterministic and re-invokable).
+  * ``step(state, action, key) -> (state', obs', reward, done)`` —
+    one transition. ``obs'`` is the POST-transition observation (the
+    terminal observation when ``done``): it is what a replay
+    transition records as ``next_obs``. ``reward``/``done`` are
+    scalar f32/bool.
+
+Two wrappers compose the single-env contract up to fleet scale:
+``AutoResetEnv`` (a done episode is replaced by a fresh one inside
+``step`` — the scan never branches on episode boundaries) and
+``BatchedEnv`` (vmap over a leading env axis with per-env key
+splitting). Order them ``BatchedEnv(AutoResetEnv(env), n)``; the
+rollout engine (envs/rollout.py) does.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# An EnvState is any pytree; envs declare their own flax.struct
+# dataclasses (envs/pose.py, envs/procgen.py).
+EnvState = Any
+Observation = Dict[str, jax.Array]
+
+
+class FunctionalEnv:
+  """Base class pinning the functional contract (see module docstring).
+
+  Subclasses hold only STATIC hyperparameters (sizes, thresholds) —
+  anything episode-specific belongs in the EnvState pytree, or the env
+  stops being a pure function of (state, action, key) and the whole
+  jit-once story collapses.
+  """
+
+  @property
+  def action_dim(self) -> int:
+    raise NotImplementedError
+
+  def observation_shapes(self) -> Dict[str, tuple]:
+    """{name: shape} of a single (unbatched) observation."""
+    raise NotImplementedError
+
+  def reset(self, key: jax.Array) -> EnvState:
+    raise NotImplementedError
+
+  def observe(self, state: EnvState) -> Observation:
+    raise NotImplementedError
+
+  def step(self, state: EnvState, action: jax.Array, key: jax.Array
+           ) -> Tuple[EnvState, Observation, jax.Array, jax.Array]:
+    raise NotImplementedError
+
+
+def select_state(done: jax.Array, if_done: EnvState,
+                 if_not: EnvState) -> EnvState:
+  """Per-leaf `where(done, a, b)` over two matching state pytrees.
+
+  `done` is a scalar bool (the unbatched auto-reset case) — it
+  broadcasts against every leaf shape from the left, so no leaf-rank
+  bookkeeping is needed.
+  """
+  return jax.tree_util.tree_map(
+      lambda a, b: jnp.where(done, a, b), if_done, if_not)
+
+
+class AutoResetEnv(FunctionalEnv):
+  """Replaces a finished episode with a fresh one inside ``step``.
+
+  Semantics (the Anakin convention): ``step`` returns the TERMINAL
+  observation as ``obs'`` (so the transition's ``next_obs`` is real),
+  while the returned ``state'`` is already the NEXT episode's reset
+  state when ``done`` — the following ``observe(state')`` starts the
+  new episode without any host-side branching. The reset key is split
+  off the step key, so a rollout's key stream fully determines every
+  episode boundary.
+  """
+
+  def __init__(self, env: FunctionalEnv):
+    self.env = env
+
+  @property
+  def action_dim(self) -> int:
+    return self.env.action_dim
+
+  def observation_shapes(self) -> Dict[str, tuple]:
+    return self.env.observation_shapes()
+
+  def reset(self, key: jax.Array) -> EnvState:
+    return self.env.reset(key)
+
+  def observe(self, state: EnvState) -> Observation:
+    return self.env.observe(state)
+
+  def step(self, state, action, key):
+    key_step, key_reset = jax.random.split(key)
+    stepped, obs, reward, done = self.env.step(state, action, key_step)
+    fresh = self.env.reset(key_reset)
+    return select_state(done, fresh, stepped), obs, reward, done
+
+
+class BatchedEnv:
+  """vmap over a leading env axis, with per-env key splitting.
+
+  Every method takes/returns pytrees with a leading ``num_envs`` axis;
+  the single key a caller passes is split so each env consumes an
+  independent PRNG stream (two envs never share an episode).
+  """
+
+  def __init__(self, env: FunctionalEnv, num_envs: int):
+    if num_envs < 1:
+      raise ValueError(f"num_envs must be >= 1, got {num_envs}")
+    self.env = env
+    self.num_envs = int(num_envs)
+    self._reset = jax.vmap(env.reset)
+    self._observe = jax.vmap(env.observe)
+    self._step = jax.vmap(env.step)
+
+  @property
+  def action_dim(self) -> int:
+    return self.env.action_dim
+
+  def reset(self, key: jax.Array) -> EnvState:
+    return self._reset(jax.random.split(key, self.num_envs))
+
+  def observe(self, states: EnvState) -> Observation:
+    return self._observe(states)
+
+  def step(self, states, actions, key):
+    return self._step(states, actions,
+                      jax.random.split(key, self.num_envs))

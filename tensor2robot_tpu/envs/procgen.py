@@ -1,0 +1,226 @@
+"""Procedurally-generated grasping env: every PRNG key a fresh scenario.
+
+The JaxARC pattern (PAPERS.md): because the env is a pure function of
+its keys, scenario generation IS the reset — geometry and dynamics are
+sampled from the episode key, so the scenario space is as large as the
+key space and a seed reproduces its scenario bit-for-bit. No scenario
+files, no host-side randomization loop.
+
+Each episode samples:
+
+  * workspace scale — the block's reachable box shrinks/grows
+    (``U[min_workspace_scale, 1] ×`` the PoseEnv box);
+  * block half-extent — target size varies (harder to see when small);
+  * sensor noise σ — per-scenario camera quality;
+  * distractor count + poses — up to ``max_distractors`` same-size
+    blue blocks the policy must NOT grasp (the red block is the
+    target);
+  * drift — a per-scenario dynamics parameter: the block slides a
+    fixed distance in a key-sampled direction after every step, so
+    multi-step episodes chase a moving target.
+
+The action contract is the pose bandit's (and the host adapter's):
+``action[:2]`` in [-1, 1]² maps onto the BASE workspace box via
+``× WORKSPACE_HIGH``; reward is proximity success against the target
+pose. Scenarios bucket by ``scenario_bucket`` (distractor count) —
+`run_success_protocol envs` sweeps seeded scenarios and reports
+success per bucket (docs/ENVS.md).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import flax
+import jax
+import jax.numpy as jnp
+
+from tensor2robot_tpu import config as gin
+from tensor2robot_tpu.envs.core import FunctionalEnv
+from tensor2robot_tpu.envs.pose import (
+    BACKGROUND,
+    BLOCK_COLOR,
+    IMAGE_SIZE,
+)
+from tensor2robot_tpu.research.pose_env.pose_env import WORKSPACE_HIGH
+
+DISTRACTOR_COLOR = (40, 80, 200)
+
+_BASE_HALF_WIDTH = float(WORKSPACE_HIGH[0])  # the ±0.4 PoseEnv box
+
+
+@flax.struct.dataclass
+class ProcGenState:
+  """One sampled scenario + its episode progress."""
+
+  pose: jax.Array          # [2] target block pose (world units)
+  distractors: jax.Array   # [max_distractors, 2] distractor poses
+  num_distractors: jax.Array  # int32 — how many render/count
+  half_extent: jax.Array   # f32 block half size (world units)
+  noise: jax.Array         # f32 per-scenario sensor noise sigma
+  drift: jax.Array         # f32 world units the target slides per step
+  workspace: jax.Array     # f32 half-width of this scenario's box
+  noise_key: jax.Array     # per-episode render-noise key
+  t: jax.Array             # int32 step counter
+
+
+@gin.configurable
+class ProcGenGraspEnv(FunctionalEnv):
+  """Key-sampled grasping scenarios over the pose-env geometry."""
+
+  def __init__(self,
+               image_size: int = IMAGE_SIZE,
+               action_dim: int = 2,
+               success_threshold: float = 0.1,
+               max_distractors: int = 3,
+               min_workspace_scale: float = 0.6,
+               half_extent_range: Tuple[float, float] = (0.03, 0.1),
+               noise_range: Tuple[float, float] = (0.0, 0.05),
+               max_drift: float = 0.05,
+               max_episode_steps: int = 1):
+    if action_dim < 2:
+      raise ValueError(
+          f"action_dim must be >= 2 (grasp point), got {action_dim}")
+    if max_distractors < 0:
+      raise ValueError(
+          f"max_distractors must be >= 0, got {max_distractors}")
+    if not 0.0 < min_workspace_scale <= 1.0:
+      raise ValueError("min_workspace_scale must be in (0, 1], got "
+                       f"{min_workspace_scale}")
+    if max_episode_steps < 1:
+      raise ValueError(
+          f"max_episode_steps must be >= 1, got {max_episode_steps}")
+    self._size = int(image_size)
+    self._action_dim = int(action_dim)
+    self._threshold = float(success_threshold)
+    self._max_distractors = int(max_distractors)
+    self._min_scale = float(min_workspace_scale)
+    self._half_range = (float(half_extent_range[0]),
+                        float(half_extent_range[1]))
+    self._noise_range = (float(noise_range[0]), float(noise_range[1]))
+    self._max_drift = float(max_drift)
+    self._max_steps = int(max_episode_steps)
+
+  @property
+  def action_dim(self) -> int:
+    return self._action_dim
+
+  @property
+  def image_size(self) -> int:
+    return self._size
+
+  @property
+  def num_buckets(self) -> int:
+    """Scenario buckets = distractor counts 0..max_distractors."""
+    return self._max_distractors + 1
+
+  def observation_shapes(self) -> Dict[str, tuple]:
+    return {"image": (self._size, self._size, 3)}
+
+  def reset(self, key: jax.Array) -> ProcGenState:
+    (key_scale, key_pose, key_count, key_distract, key_half,
+     key_noise_level, key_drift, key_noise) = jax.random.split(key, 8)
+    scale = jax.random.uniform(
+        key_scale, (), minval=self._min_scale, maxval=1.0)
+    workspace = jnp.float32(_BASE_HALF_WIDTH) * scale
+    pose = jax.random.uniform(
+        key_pose, (2,), minval=-workspace,
+        maxval=workspace).astype(jnp.float32)
+    num = jax.random.randint(
+        key_count, (), 0, self._max_distractors + 1)
+    distractors = jax.random.uniform(
+        key_distract, (max(self._max_distractors, 1), 2),
+        minval=-workspace, maxval=workspace).astype(jnp.float32)
+    half = jax.random.uniform(
+        key_half, (), minval=self._half_range[0],
+        maxval=self._half_range[1])
+    noise = jax.random.uniform(
+        key_noise_level, (), minval=self._noise_range[0],
+        maxval=self._noise_range[1])
+    drift = jax.random.uniform(
+        key_drift, (), minval=0.0, maxval=self._max_drift)
+    return ProcGenState(
+        pose=pose, distractors=distractors,
+        num_distractors=num.astype(jnp.int32),
+        half_extent=half.astype(jnp.float32),
+        noise=noise.astype(jnp.float32),
+        drift=drift.astype(jnp.float32),
+        workspace=workspace.astype(jnp.float32),
+        noise_key=key_noise, t=jnp.zeros((), jnp.int32))
+
+  def scenario_bucket(self, state: ProcGenState) -> jax.Array:
+    """int32 robustness-eval bucket id (distractor count)."""
+    return state.num_distractors
+
+  # ---- rendering ----
+
+  def _to_pixel(self, xy: jax.Array, workspace: jax.Array
+                ) -> jax.Array:
+    """World → pixel under the SCENARIO's box (dynamic half-width);
+    the PoseEnv mapping with workspace as a traced value."""
+    frac = (xy + workspace) / (2.0 * workspace)
+    return jnp.clip((frac * self._size).astype(jnp.int32), 0,
+                    self._size - 1)
+
+  def _block_mask(self, center_px: jax.Array,
+                  extent_px: jax.Array) -> jax.Array:
+    rows = jnp.arange(self._size)
+    in_y = ((rows >= center_px[1] - extent_px)
+            & (rows <= center_px[1] + extent_px))
+    in_x = ((rows >= center_px[0] - extent_px)
+            & (rows <= center_px[0] + extent_px))
+    return in_y[:, None] & in_x[None, :]
+
+  def observe(self, state: ProcGenState) -> Dict[str, jax.Array]:
+    size = self._size
+    base = jnp.full((size, size, 3), float(BACKGROUND))
+    sensor = 255.0 * state.noise * jax.random.normal(
+        state.noise_key, (size, size, 3))
+    image = jnp.clip(base + sensor, 0, 255).astype(jnp.uint8)
+    extent_px = jnp.maximum(1, (state.half_extent
+                                / (2.0 * state.workspace)
+                                * size).astype(jnp.int32))
+    # Distractors first (vectorized over the static max count, masked
+    # down to the sampled count), target last so it always occludes.
+    centers = jax.vmap(self._to_pixel, in_axes=(0, None))(
+        state.distractors, state.workspace)
+    masks = jax.vmap(self._block_mask, in_axes=(0, None))(
+        centers, extent_px)
+    active = (jnp.arange(masks.shape[0])
+              < state.num_distractors)[:, None, None]
+    distractor_mask = jnp.any(masks & active, axis=0)[..., None]
+    image = jnp.where(distractor_mask,
+                      jnp.asarray(DISTRACTOR_COLOR, jnp.uint8), image)
+    target_mask = self._block_mask(
+        self._to_pixel(state.pose, state.workspace), extent_px)
+    return {"image": jnp.where(target_mask[..., None],
+                               jnp.asarray(BLOCK_COLOR, jnp.uint8),
+                               image)}
+
+  # ---- dynamics ----
+
+  def grasp_reward(self, action: jax.Array,
+                   pose: jax.Array) -> jax.Array:
+    """Same mapping as the pose bandit: [-1, 1]² onto the BASE box."""
+    grasp = (action[:2].astype(jnp.float32)
+             * jnp.float32(_BASE_HALF_WIDTH))
+    dist = jnp.linalg.norm(grasp - pose.astype(jnp.float32))
+    return (dist < self._threshold).astype(jnp.float32)
+
+  def step(self, state: ProcGenState, action: jax.Array,
+           key: jax.Array
+           ) -> Tuple[ProcGenState, Dict[str, jax.Array], jax.Array,
+                      jax.Array]:
+    reward = self.grasp_reward(action, state.pose)
+    # Dynamics: the target slides `drift` world units in a key-sampled
+    # direction (per-scenario magnitude, per-step direction).
+    angle = jax.random.uniform(key, (), minval=0.0,
+                               maxval=2.0 * jnp.pi)
+    slide = state.drift * jnp.stack(
+        [jnp.cos(angle), jnp.sin(angle)])
+    pose = jnp.clip(state.pose + slide, -state.workspace,
+                    state.workspace)
+    t_next = state.t + 1
+    done = (reward > 0.5) | (t_next >= self._max_steps)
+    next_state = state.replace(pose=pose, t=t_next)
+    return next_state, self.observe(next_state), reward, done

@@ -1,0 +1,580 @@
+"""Anakin-style fully-on-device rollouts closing the loop into QT-Opt.
+
+Podracer's Anakin architecture (PAPERS.md, arXiv:2104.06272): when the
+env is a pure function (envs/core.py), acting and environment stepping
+compile into the SAME device program as training — `lax.scan` over
+steps × `vmap` over envs — so thousands of parallel envs run per
+dispatch and no transition ever crosses the host data plane. Compare
+the fleet topology (docs/FLEET.md): there every transition pays
+RPC + ingestion queue + sampling, and actors act on params up to a
+publish-cadence stale. Here the rollout policy reads the CURRENT
+learner params inside the very program that updates them —
+``param_refresh_lag`` is zero by construction, and the only host
+traffic is the metrics scalar pull at the log cadence.
+
+Three layers, composable separately:
+
+  * ``rollout`` / ``make_collect_fn`` — the scan×vmap engine producing
+    replay-wire-spec transition batches ([T·N] rows matching
+    `QTOptLearner.transition_specification`).
+  * ``train_anakin`` — the `--trainer=anakin` online mode: one jitted
+    iteration = collect a segment into a DEVICE-RESIDENT replay ring +
+    K Bellman grad steps on uniform samples from it. The ring is part
+    of the donated carry — QT-Opt stays off-policy-capable without a
+    host replay service.
+  * ``JaxEnvBandit`` / ``evaluate_scenarios`` — the host seams: the
+    batched-bandit adapter `GraspActor` drives (a functional env as a
+    scenario source), and the seeded procedural scenario sweep
+    `run_success_protocol envs` reports per-bucket success over.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Callable, Dict, Iterable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu import config as gin
+from tensor2robot_tpu.envs.core import (
+    AutoResetEnv,
+    BatchedEnv,
+    FunctionalEnv,
+)
+from tensor2robot_tpu.envs.pose import PoseBanditEnv
+from tensor2robot_tpu.envs.procgen import ProcGenGraspEnv
+
+log = logging.getLogger(__name__)
+
+# The replay wire keys a single-camera transition batch carries
+# (`QTOptLearner.transition_specification` for the flagship model).
+WIRE_KEYS = ("image", "action", "reward", "done", "next_image")
+
+
+def make_batched(env: FunctionalEnv, num_envs: int) -> BatchedEnv:
+  """The canonical composition: auto-reset inside, vmap outside."""
+  return BatchedEnv(AutoResetEnv(env), num_envs)
+
+
+def rollout(batched: BatchedEnv,
+            policy_fn: Callable[[Dict[str, jax.Array], jax.Array],
+                                jax.Array],
+            env_states, key: jax.Array, length: int):
+  """`length` steps of every env in one `lax.scan`.
+
+  ``policy_fn(obs, key) -> actions [N, A]`` acts on the batched
+  observation. Returns ``(env_states', traj)`` where every traj leaf
+  is [length, num_envs, ...] — transitions in wire order: ``image`` is
+  the acting observation, ``next_image`` the post-transition one
+  (terminal frame at episode ends, the auto-reset contract).
+  """
+
+  def body(states, step_key):
+    # Two renders per env-step land here: this observe, and the
+    # terminal observe inside step. For a continuing env they compute
+    # the same frame, but XLA cannot CSE across the scan carry — and
+    # restructuring to carry obs does NOT reduce the count: the next
+    # acting obs needs the RESET frame where done, and under vmap the
+    # done-select computes both branches for every env regardless.
+    # One render/step is only reachable by storing the post-reset
+    # frame as next_obs for done rows (wire-dishonest: replay would
+    # carry the next episode's frame as a terminal observation).
+    # Measured bound on the waste: render+step is ~17% of a
+    # CEM-acting iteration (bench --envs: 36.5k stepping ceiling vs
+    # 6.4k), so the redundant half is <9% — not worth the contract.
+    obs = batched.observe(states)
+    key_act, key_step = jax.random.split(step_key)
+    actions = policy_fn(obs, key_act)
+    next_states, next_obs, reward, done = batched.step(
+        states, actions, key_step)
+    transition = {
+        "image": obs["image"],
+        "action": actions,
+        "reward": reward[:, None].astype(jnp.float32),
+        "done": done[:, None].astype(jnp.float32),
+        "next_image": next_obs["image"],
+    }
+    return next_states, transition
+
+  return jax.lax.scan(body, env_states,
+                      jax.random.split(key, length))
+
+
+def flatten_time(traj):
+  """[T, N, ...] → [T·N, ...]: a traj as one replay-wire batch."""
+  return jax.tree_util.tree_map(
+      lambda x: x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:]),
+      traj)
+
+
+def _check_wire_spec(learner) -> None:
+  """train_anakin covers models whose transition spec is exactly the
+  single-camera wire (image/action/reward/done/next_image): an env
+  only renders images, so extra state features would sample as
+  garbage. Fail loudly at setup instead."""
+  spec = learner.transition_specification().to_flat_dict()
+  extra = sorted(set(spec) - set(WIRE_KEYS))
+  if extra:
+    raise ValueError(
+        "train_anakin needs a {image, action} model; the transition "
+        f"spec carries extra keys the env cannot produce: {extra}")
+
+
+def make_collect_fn(learner, env: FunctionalEnv, num_envs: int,
+                    rollout_length: int, epsilon: float = 0.1,
+                    cem_population: Optional[int] = None,
+                    cem_iterations: Optional[int] = None):
+  """(init_fn, collect_fn) for ε-greedy CEM collection on device.
+
+  ``init_fn(key) -> env_states`` resets the batch;
+  ``collect_fn(learner_state, env_states, key) -> (env_states',
+  batch)`` rolls ``rollout_length`` steps of ``num_envs`` envs with
+  the CEM policy over the passed learner params (ε-greedy per env-step
+  — the actor fleet's exploration rule) and returns a flat
+  [T·N]-row wire batch.
+  """
+  _check_wire_spec(learner)
+  batched = make_batched(env, num_envs)
+  policy = learner.build_policy(cem_population=cem_population,
+                                cem_iterations=cem_iterations)
+  epsilon = float(epsilon)
+  from tensor2robot_tpu.specs import TensorSpecStruct
+
+  def init_fn(key):
+    return batched.reset(key)
+
+  def collect_fn(learner_state, env_states, key):
+    def policy_fn(obs, act_key):
+      key_cem, key_eps, key_rand = jax.random.split(act_key, 3)
+      greedy = policy(learner_state,
+                      TensorSpecStruct.from_flat_dict(obs), key_cem)
+      random_actions = jax.random.uniform(
+          key_rand, greedy.shape, minval=-1.0, maxval=1.0)
+      explore = (jax.random.uniform(key_eps, (num_envs,)) < epsilon)
+      return jnp.where(explore[:, None], random_actions,
+                       greedy).astype(jnp.float32)
+
+    env_states, traj = rollout(batched, policy_fn, env_states, key,
+                               rollout_length)
+    return env_states, flatten_time(traj)
+
+  return init_fn, collect_fn
+
+
+def make_anakin_collect_fn(learner, env: FunctionalEnv,
+                           num_envs: int, rollout_length: int,
+                           epsilon: float = 0.1,
+                           devices=None,
+                           cem_population: Optional[int] = None,
+                           cem_iterations: Optional[int] = None):
+  """The full Anakin topology: vmap over envs INSIDE pmap over devices.
+
+  Podracer's Anakin diagram verbatim (PAPERS.md): each device runs
+  ``num_envs / D`` vmapped envs through the scan; the learner state
+  broadcasts (in_axes=None) so every device acts with the same — and
+  current — params. On a TPU host the pmap axis is the local chips; on
+  CPU the 8-virtual-device mesh stands in AND sidesteps XLA:CPU's
+  intra-op parallelism ceiling (one jitted rollout program leaves
+  ~2/3 of a 24-core host idle — measured on the bench --envs axis —
+  while the pmap'd twin saturates it).
+
+  Returns ``(init_fn, collect_fn)`` shaped like `make_collect_fn` but
+  with a leading device axis on env states and collected batches
+  ([D, T·N/D, ...] — `flatten_devices` folds it away).
+  """
+  devices = list(devices if devices is not None
+                 else jax.local_devices())
+  num_devices = len(devices)
+  if num_envs % num_devices:
+    raise ValueError(
+        f"num_envs={num_envs} must divide across {num_devices} "
+        "devices (pass devices= to pin a subset)")
+  per_device = num_envs // num_devices
+  inner_init, inner_collect = make_collect_fn(
+      learner, env, per_device, rollout_length, epsilon=epsilon,
+      cem_population=cem_population, cem_iterations=cem_iterations)
+  pmap_init = jax.pmap(inner_init, devices=devices)
+  pmap_collect = jax.pmap(inner_collect, in_axes=(None, 0, 0),
+                          devices=devices)
+
+  def init_fn(key):
+    return pmap_init(jax.random.split(key, num_devices))
+
+  def collect_fn(learner_state, env_states, key):
+    return pmap_collect(learner_state, env_states,
+                        jax.random.split(key, num_devices))
+
+  return init_fn, collect_fn
+
+
+def flatten_devices(batch):
+  """[D, R, ...] → [D·R, ...]: a pmap'd collection as one wire batch."""
+  return jax.tree_util.tree_map(
+      lambda x: x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:]),
+      batch)
+
+
+def _build_env(env_family: str, model) -> FunctionalEnv:
+  if env_family == "pose":
+    return PoseBanditEnv(image_size=model.image_size,
+                         action_dim=model.action_dim)
+  if env_family == "procgen":
+    return ProcGenGraspEnv(image_size=model.image_size,
+                           action_dim=model.action_dim)
+  raise ValueError(f"env_family={env_family!r} not in "
+                   "('pose', 'procgen') and no env was passed")
+
+
+@gin.configurable
+def train_anakin(
+    learner=gin.REQUIRED,
+    model_dir: str = gin.REQUIRED,
+    env: Optional[FunctionalEnv] = None,
+    env_family: str = "pose",
+    num_envs: int = 256,
+    rollout_length: int = 4,
+    train_batches_per_iter: int = 4,
+    batch_size: int = 256,
+    replay_capacity: int = 16384,
+    max_train_steps: int = 1000,
+    log_every_steps: int = 100,
+    save_checkpoints_steps: int = 500,
+    max_checkpoints_to_keep: int = 5,
+    epsilon: float = 0.1,
+    cem_population: Optional[int] = None,
+    cem_iterations: Optional[int] = None,
+    hooks: Iterable = (),
+    seed: int = 0,
+):
+  """QT-Opt online training with fully-on-device collection.
+
+  One jitted iteration (traced ONCE — the jit-once pin in
+  tests/test_envs.py):
+
+    1. roll ``rollout_length`` steps of ``num_envs`` auto-resetting
+       envs with the ε-greedy CEM policy over the CURRENT params,
+    2. write the [T·N] wire batch into a device-resident replay ring
+       (part of the donated carry; capacity rounds up to a multiple of
+       the segment so inserts are one contiguous dynamic slice),
+    3. run ``train_batches_per_iter`` Bellman grad steps on uniform
+       samples from the filled prefix.
+
+  The iteration quantum is `train_qtopt`'s ``steps_per_dispatch``:
+  every cadence must be a multiple of ``train_batches_per_iter``, and
+  per-step PRNG folds by absolute step. Collection state (env states,
+  ring) is ephemeral — a resume restarts collection but restores the
+  learner exactly.
+
+  Because acting params == training params inside one program,
+  ``param_refresh_lag`` is 0 by construction (logged as such, so the
+  fleet's lag dashboards stay comparable); replay staleness is bounded
+  by ``capacity / (num_envs · rollout_length)`` iterations.
+  """
+  from tensor2robot_tpu.data import prefetch as prefetch_lib
+  from tensor2robot_tpu.hooks import HookList
+  from tensor2robot_tpu.specs import TensorSpecStruct
+  from tensor2robot_tpu.train_eval import MetricLogger
+  from tensor2robot_tpu.utils import checkpoints as ckpt_lib
+
+  k = prefetch_lib.validate_steps_per_dispatch(
+      train_batches_per_iter,
+      log_every_steps=log_every_steps,
+      save_checkpoints_steps=save_checkpoints_steps,
+      max_train_steps=max_train_steps)
+  if env is None:
+    env = _build_env(env_family, learner.model)
+  rows = num_envs * rollout_length
+  capacity = max(int(replay_capacity), batch_size, rows)
+  capacity = ((capacity + rows - 1) // rows) * rows
+  _check_wire_spec(learner)
+  spec = learner.transition_specification().to_flat_dict()
+
+  os.makedirs(model_dir, exist_ok=True)
+  metric_logger = MetricLogger(model_dir)
+  hook_list = HookList(list(hooks))
+
+  rng = jax.random.PRNGKey(seed)
+  state = learner.create_state(rng, batch_size=2)
+  resume_step = ckpt_lib.latest_step(model_dir)
+  if resume_step is not None:
+    log.info("Resuming anakin QT-Opt from step %d", resume_step)
+    state = ckpt_lib.restore_state(model_dir, like=state,
+                                   step=resume_step)
+  step = int(np.asarray(jax.device_get(state.step)))
+  if k > 1 and step % k and step < max_train_steps:
+    metric_logger.close()
+    raise ValueError(
+        f"Resumed at step {step}, not a multiple of "
+        f"train_batches_per_iter={k}: the checkpoint/log boundaries "
+        "would never align.")
+
+  init_fn, collect_fn = make_collect_fn(
+      learner, env, num_envs, rollout_length, epsilon=epsilon,
+      cem_population=cem_population, cem_iterations=cem_iterations)
+  env_states = jax.jit(init_fn)(jax.random.PRNGKey(seed + 2))
+
+  if getattr(learner, "needs_calibration", False):
+    # int8 CEM tower: activation scales are trace-time constants.
+    # Calibrate on REAL rendered frames — the batched envs' first
+    # observations — before anything traces the quantized tower.
+    obs0 = jax.jit(jax.vmap(env.observe))(
+        jax.tree_util.tree_map(lambda x: x[:min(num_envs, 64)],
+                               env_states))
+    learner.calibrate(state, {
+        "image": obs0["image"],
+        "action": jax.random.uniform(
+            jax.random.PRNGKey(seed + 3),
+            (obs0["image"].shape[0], learner.model.action_dim),
+            minval=-1.0, maxval=1.0),
+    })
+
+  replay = {
+      key: jnp.zeros((capacity,) + tuple(sp.shape),
+                     dtype=sp.dtype)
+      for key, sp in spec.items()}
+  size0 = jnp.zeros((), jnp.int32)
+  ptr0 = jnp.zeros((), jnp.int32)
+  step_rng = jax.random.PRNGKey(seed + 1)
+
+  def iteration(carry, key):
+    qstate, states, ring, size, ptr = carry
+    key_collect, _ = jax.random.split(key)
+    states, batch = collect_fn(qstate, states, key_collect)
+    ring = {
+        name: jax.lax.dynamic_update_slice(
+            ring[name], batch[name],
+            (ptr,) + (0,) * (ring[name].ndim - 1))
+        for name in ring}
+    size = jnp.minimum(size + rows, capacity)
+    ptr = (ptr + rows) % capacity
+
+    def train_body(st, _):
+      base = jax.random.fold_in(step_rng, st.step)
+      key_sample, key_net = jax.random.split(base)
+      idx = jax.random.randint(key_sample, (batch_size,), 0, size)
+      minibatch = TensorSpecStruct.from_flat_dict(
+          {name: ring[name][idx] for name in ring})
+      return learner.train_step(st, minibatch, key_net)
+
+    qstate, metrics_seq = jax.lax.scan(
+        train_body, qstate, jnp.arange(k))
+    # Per-step hooks observe each dispatch's LAST metrics — the
+    # train_qtopt K>1 convention.
+    metrics = jax.tree_util.tree_map(lambda m: m[-1], metrics_seq)
+    metrics["collect_reward_mean"] = jnp.mean(batch["reward"])
+    metrics["replay_fill"] = size.astype(jnp.float32) / capacity
+    return (qstate, states, ring, size, ptr), metrics
+
+  anakin_step = jax.jit(iteration, donate_argnums=(0,))
+
+  hook_list.begin(learner.model, model_dir)
+  writer = ckpt_lib.CheckpointWriter(
+      model_dir, max_to_keep=max_checkpoints_to_keep)
+  carry = (state, env_states, replay, size0, ptr0)
+  iter_key = jax.random.PRNGKey(seed + 4)
+  t_last = time.time()
+  steps_since_log = 0
+  last_saved = resume_step
+  try:
+    while step < max_train_steps:
+      carry, metrics = anakin_step(
+          carry, jax.random.fold_in(iter_key, step))
+      step += k
+      steps_since_log += k
+      hook_list.after_step(step, metrics)
+      if step % log_every_steps == 0 or step == max_train_steps:
+        scalars = jax.device_get(metrics)
+        dt = time.time() - t_last
+        iters = steps_since_log // k
+        scalars["grad_steps_per_sec"] = steps_since_log / max(dt, 1e-9)
+        scalars["env_steps_per_sec"] = (iters * rows) / max(dt, 1e-9)
+        # Zero BY CONSTRUCTION (acting params == training params in
+        # one program) — logged so fleet-mode dashboards compare.
+        scalars["param_refresh_lag_steps"] = 0.0
+        metric_logger.write("train", step, scalars)
+        t_last = time.time()
+        steps_since_log = 0
+      if step % save_checkpoints_steps == 0 or step == max_train_steps:
+        host_state = jax.device_get(carry[0])
+        writer.save(step, host_state,
+                    params=host_state.train_state.params,
+                    batch_stats=host_state.train_state.batch_stats)
+        last_saved = step
+        hook_list.after_checkpoint(step, carry[0].train_state,
+                                   model_dir)
+    if last_saved != step:
+      host_state = jax.device_get(carry[0])
+      writer.save(step, host_state,
+                  params=host_state.train_state.params,
+                  batch_stats=host_state.train_state.batch_stats)
+      hook_list.after_checkpoint(step, carry[0].train_state, model_dir)
+  finally:
+    try:
+      hook_list.end(step, carry[0].train_state, model_dir)
+    except Exception:  # noqa: BLE001 — don't mask the original error
+      log.exception("hook end() failed during teardown")
+    writer.close()
+    metric_logger.close()
+  return carry[0]
+
+
+@gin.configurable
+class JaxEnvBandit:
+  """Functional env → the host batched-bandit interface.
+
+  `GraspActor` (and the success-protocol evals) speak
+  ``reset_batch / grade / action_dim / sample_transitions`` —
+  `ToyGraspEnv`'s vectorized single-step contract. This adapter lets
+  any functional env serve as that scenario source: reset+render run
+  as one jitted program per batch size, ``grade`` is the env's own
+  reward function (vmapped, so host and device rewards can never
+  drift). Intended for in-process actors and evals; fleet actor
+  processes stay jax-free and keep using the MuJoCo adapter.
+  """
+
+  def __init__(self, env: Optional[FunctionalEnv] = None,
+               seed: int = 0, **env_kwargs):
+    self._env = env if env is not None else ProcGenGraspEnv(
+        **env_kwargs)
+    self._key = jax.random.PRNGKey(seed)
+    self._reset_cache: Dict[int, Callable] = {}
+    self._grade = jax.jit(jax.vmap(self._env.grasp_reward))
+    self._rng = np.random.default_rng(seed)
+    # Scenario attribution for robustness summaries: the bucket ids of
+    # the most recent reset_batch (procgen; None for bucketless envs).
+    self.last_buckets: Optional[np.ndarray] = None
+
+  @property
+  def env(self) -> FunctionalEnv:
+    return self._env
+
+  @property
+  def action_dim(self) -> int:
+    return self._env.action_dim
+
+  def _reset_fn(self, n: int):
+    fn = self._reset_cache.get(n)
+    if fn is None:
+      env = self._env
+
+      def reset_and_observe(key):
+        states = jax.vmap(env.reset)(jax.random.split(key, n))
+        obs = jax.vmap(env.observe)(states)
+        poses = states.pose
+        bucket = (jax.vmap(env.scenario_bucket)(states)
+                  if hasattr(env, "scenario_bucket") else None)
+        return obs, poses, bucket
+
+      fn = jax.jit(reset_and_observe)
+      self._reset_cache[n] = fn
+    return fn
+
+  def reset_batch(self, n: int
+                  ) -> Tuple[Dict[str, np.ndarray], np.ndarray]:
+    """N fresh scenarios: ({image: [N, S, S, 3]}, target poses)."""
+    self._key, sub = jax.random.split(self._key)
+    obs, poses, bucket = self._reset_fn(n)(sub)
+    self.last_buckets = (None if bucket is None
+                         else np.asarray(jax.device_get(bucket)))
+    return ({k: np.asarray(jax.device_get(v))
+             for k, v in obs.items()},
+            np.asarray(jax.device_get(poses)))
+
+  def grade(self, actions: np.ndarray,
+            positions: np.ndarray) -> np.ndarray:
+    return np.asarray(jax.device_get(self._grade(
+        jnp.asarray(actions, jnp.float32),
+        jnp.asarray(positions, jnp.float32))))
+
+  def sample_transitions(self, n: int) -> Dict[str, np.ndarray]:
+    """N random-policy transitions in the learner's replay layout."""
+    observations, positions = self.reset_batch(n)
+    actions = self._rng.uniform(
+        -1, 1, (n, self._env.action_dim)).astype(np.float32)
+    reward = self.grade(actions, positions)
+    return {
+        "image": observations["image"],
+        "action": actions,
+        "reward": reward[:, None].astype(np.float32),
+        "done": np.ones((n, 1), np.float32),
+        "next_image": observations["image"],
+    }
+
+
+@gin.configurable
+def evaluate_scenarios(
+    learner,
+    state,
+    env: Optional[FunctionalEnv] = None,
+    num_scenarios: int = 512,
+    seed: int = 0,
+    cem_population: Optional[int] = None,
+    cem_iterations: Optional[int] = None,
+) -> Dict[str, object]:
+  """Seeded procedural robustness sweep: success per scenario bucket.
+
+  One device program resets ``num_scenarios`` key-sampled scenarios,
+  selects every action with the CEM policy, and grades them; results
+  group by ``scenario_bucket`` (distractor count for procgen). The
+  same seed reproduces the same scenarios AND the same action stream —
+  ``action_digest`` (SHA-256 over the action bytes) is the
+  reproducibility handle `run_success_protocol seedcheck` pins.
+  """
+  import hashlib
+
+  from tensor2robot_tpu.specs import TensorSpecStruct
+
+  if env is None:
+    env = ProcGenGraspEnv(image_size=learner.model.image_size,
+                          action_dim=learner.model.action_dim)
+  policy = learner.build_policy(cem_population=cem_population,
+                                cem_iterations=cem_iterations)
+
+  def sweep(policy_state, key):
+    key_env, key_cem = jax.random.split(key)
+    states = jax.vmap(env.reset)(
+        jax.random.split(key_env, num_scenarios))
+    obs = jax.vmap(env.observe)(states)
+    actions = policy(policy_state,
+                     TensorSpecStruct.from_flat_dict(obs), key_cem)
+    rewards = jax.vmap(env.grasp_reward)(actions, states.pose)
+    bucket = (jax.vmap(env.scenario_bucket)(states)
+              if hasattr(env, "scenario_bucket")
+              else jnp.zeros((num_scenarios,), jnp.int32))
+    return actions, rewards, bucket, states.pose
+
+  actions, rewards, bucket, poses = jax.jit(sweep)(
+      state, jax.random.PRNGKey(seed))
+  actions = np.asarray(jax.device_get(actions))
+  rewards = np.asarray(jax.device_get(rewards))
+  bucket = np.asarray(jax.device_get(bucket))
+  poses = np.asarray(jax.device_get(poses))
+
+  num_buckets = int(getattr(env, "num_buckets", 1))
+  per_bucket = {}
+  for b in range(num_buckets):
+    mask = bucket == b
+    per_bucket[str(b)] = {
+        "count": int(mask.sum()),
+        "success_rate": (float(rewards[mask].mean())
+                         if mask.any() else None),
+    }
+  random_actions = np.random.default_rng(seed + 1).uniform(
+      -1, 1, actions.shape).astype(np.float32)
+  random_rewards = np.asarray(jax.device_get(jax.vmap(
+      env.grasp_reward)(jnp.asarray(random_actions),
+                        jnp.asarray(poses))))
+  return {
+      "success_rate": float(rewards.mean()),
+      "random_baseline_success_rate": float(random_rewards.mean()),
+      "per_bucket": per_bucket,
+      "num_scenarios": int(num_scenarios),
+      "action_digest": hashlib.sha256(
+          np.ascontiguousarray(actions).tobytes()).hexdigest(),
+      "scenario_digest": hashlib.sha256(
+          np.ascontiguousarray(poses).tobytes()).hexdigest(),
+  }

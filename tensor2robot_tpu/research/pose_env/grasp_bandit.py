@@ -32,6 +32,19 @@ from tensor2robot_tpu.research.pose_env.pose_env import (
 )
 
 
+def grade_grasp(actions: np.ndarray, positions: np.ndarray,
+                threshold: float) -> np.ndarray:
+  """THE host grading rule: normalized grasp point → workspace box →
+  proximity success. Module-level so it is one function, not a method
+  buried in env plumbing: the JAX env family mirrors it exactly
+  (`envs.pose.PoseBanditEnv.grasp_reward` — the host-vs-device parity
+  pin in tests/test_envs.py compares the two on matched geometry)."""
+  grasp = np.asarray(actions, np.float32)[:, :2] * WORKSPACE_HIGH
+  dist = np.linalg.norm(grasp - np.asarray(positions, np.float32),
+                        axis=-1)
+  return (dist < threshold).astype(np.float32)
+
+
 @gin.configurable
 class PoseGraspBandit:
   """Batched single-step grasp bandit over a (MuJoCo) pose env."""
@@ -79,6 +92,12 @@ class PoseGraspBandit:
     return self._action_dim
 
   @property
+  def success_threshold(self) -> float:
+    """Max grasp-point error in WORLD units — the grading geometry a
+    device twin must match (`envs.pose.host_parity_env`)."""
+    return self._threshold
+
+  @property
   def env(self):
     return self._env
 
@@ -101,10 +120,7 @@ class PoseGraspBandit:
     (symmetric about the origin), `positions` are world-unit poses
     from `reset_batch`.
     """
-    grasp = np.asarray(actions, np.float32)[:, :2] * WORKSPACE_HIGH
-    dist = np.linalg.norm(grasp - np.asarray(positions, np.float32),
-                          axis=-1)
-    return (dist < self._threshold).astype(np.float32)
+    return grade_grasp(actions, positions, self._threshold)
 
   def sample_transitions(self, n: int) -> Dict[str, np.ndarray]:
     """N random-policy transitions in the learner's replay layout
@@ -124,4 +140,5 @@ class PoseGraspBandit:
 
 
 # Re-exported for callers that reason about the action mapping.
-__all__ = ["PoseGraspBandit", "WORKSPACE_LOW", "WORKSPACE_HIGH"]
+__all__ = ["PoseGraspBandit", "grade_grasp", "WORKSPACE_LOW",
+           "WORKSPACE_HIGH"]
