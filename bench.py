@@ -2306,7 +2306,8 @@ def main():
   if "--envs" in args and "--dry-run" in args:
     # Tier-1 smoke of the on-device envs bench path: tiny env/model,
     # the full subprocess topology (virtual mesh, pmap scale-out,
-    # interleaved trainer, parity pin), NO detail-file write.
+    # interleaved trainer, the 2-virtual-device pod device-scaling
+    # leg, parity pin), NO detail-file write.
     smoke = bench_envs(dry_run=True)
     scaleout = smoke.get("anakin_scaleout") or {}
     print(json.dumps({
@@ -2319,6 +2320,14 @@ def main():
             scaleout.get("env_steps_per_sec"),
         "param_refresh_lag_steps":
             smoke["train_interleaved"]["param_refresh_lag_steps"],
+        # The pod leg: the 1-device row is the PR-9 jit program, the
+        # 2-device row the pmap'd pod — lag must be 0.0 on BOTH.
+        "device_scaling_grad_steps_per_sec": {
+            str(row["devices"]): row["grad_steps_per_sec"]
+            for row in smoke["device_scaling"]["rows"]},
+        "device_scaling_lag_steps": [
+            row["param_refresh_lag_steps"]
+            for row in smoke["device_scaling"]["rows"]],
         "pose_parity_reward_max_abs_diff":
             smoke["pose_parity"]["reward_max_abs_diff"],
         "pose_parity_image_bitwise":

@@ -209,6 +209,15 @@ def main() -> None:
 
   from tensor2robot_tpu.envs import train_anakin
 
+  def anakin_last_log_row(num_devices, kwargs):
+    """One --trainer=anakin training; returns the LAST log window's
+    metrics row (warm: the first window absorbs the compile)."""
+    with tempfile.TemporaryDirectory() as tmp:
+      train_anakin(learner=learner, model_dir=tmp, env=env, seed=0,
+                   num_devices=num_devices, **kwargs)
+      return [json.loads(line) for line in
+              open(os.path.join(tmp, "metrics_train.jsonl"))][-1]
+
   with tempfile.TemporaryDirectory() as tmp:
     if dry_run:
       kwargs = dict(num_envs=16, rollout_length=2,
@@ -237,6 +246,63 @@ def main() -> None:
                "lag is zero by construction"),
   }
 
+  # --- device-scaling leg: pod-mode SPMD training (ISSUE 10) ---
+  # STRONG scaling on collection, pmean'd scaling on learning: total
+  # envs fixed, per-device Bellman batch fixed (global batch grows
+  # with D — the Podracer pmean semantics), so adding devices shrinks
+  # the iteration wall and BOTH env-steps/s and grad-steps/s rise.
+  # The 1-device row runs the PR-9 single-device jitted program (the
+  # comparator the pinned bitwise test ties pod D=1 to); rows >= 2 run
+  # the pmap'd pod program.
+  if dry_run:
+    scale_counts = [c for c in (1, 2) if c <= len(devices)]
+    scale_kwargs = dict(num_envs=16, rollout_length=2,
+                        train_batches_per_iter=2, batch_size=8,
+                        replay_capacity=128, max_train_steps=8,
+                        log_every_steps=4, save_checkpoints_steps=8)
+  else:
+    scale_counts = [c for c in (1, 2, 4, 8) if c <= len(devices)]
+    scale_kwargs = dict(num_envs=1024, rollout_length=64,
+                        train_batches_per_iter=4, batch_size=64,
+                        replay_capacity=65536, max_train_steps=24,
+                        log_every_steps=12, save_checkpoints_steps=24)
+  scale_rows = []
+  for count in scale_counts:
+    row = anakin_last_log_row(None if count == 1 else count,
+                              scale_kwargs)
+    scale_rows.append({
+        "devices": count,
+        "program": ("jit (PR-9 single-device)" if count == 1
+                    else "pmap (pod)"),
+        "env_steps_per_sec": round(row["env_steps_per_sec"], 1),
+        "grad_steps_per_sec": round(row["grad_steps_per_sec"], 2),
+        "bellman_batches_per_sec": round(
+            row.get("bellman_batches_per_sec",
+                    row["grad_steps_per_sec"]), 2),
+        "global_batch_size": int(row.get("global_batch_size",
+                                         scale_kwargs["batch_size"])),
+        "param_refresh_lag_steps": row["param_refresh_lag_steps"],
+    })
+  device_scaling = {
+      "config": {
+          "num_envs_total": scale_kwargs["num_envs"],
+          "rollout_length": scale_kwargs["rollout_length"],
+          "train_batches_per_iter":
+              scale_kwargs["train_batches_per_iter"],
+          "per_device_batch": scale_kwargs["batch_size"],
+          "note": ("total envs fixed (strong scaling on collection); "
+                   "per-device Bellman batch fixed, gradients "
+                   "pmean'd — global batch = D x per_device_batch"),
+      },
+      "rows": scale_rows,
+      "grad_steps_speedup_at_max_devices": round(
+          scale_rows[-1]["grad_steps_per_sec"]
+          / scale_rows[0]["grad_steps_per_sec"], 2),
+      "env_steps_speedup_at_max_devices": round(
+          scale_rows[-1]["env_steps_per_sec"]
+          / scale_rows[0]["env_steps_per_sec"], 2),
+  }
+
   result = {
       "device_kind": devices[0].device_kind,
       "backend": jax.default_backend(),
@@ -251,6 +317,7 @@ def main() -> None:
       "anakin_scaleout": scaleout,
       "random_policy_ceiling": random_ceiling,
       "train_interleaved": interleaved,
+      "device_scaling": device_scaling,
       "pose_parity": _pose_parity(image, parity_episodes),
       "note": (
           "env-steps/s counts collected transitions (auto-reset "

@@ -456,13 +456,61 @@ def run_seedcheck(tmp: str) -> None:
     return {"scenario_sweep_action_sha256": sweep["action_digest"],
             "scenario_sweep_scenario_sha256": sweep["scenario_digest"]}
 
+  def pod_pass():
+    # Pod-scale Anakin reproducibility (ISSUE 10): the pmap'd
+    # collect-and-learn program must reproduce the SAME final learner
+    # params from PROTOCOL_SEED at EVERY device count — per-device
+    # PRNG folds by absolute step + axis index, so each count is its
+    # own deterministic experiment. Digests are recorded per count
+    # (1 = the PR-9 single-device jit program, >=2 = the pmap'd pod;
+    # counts above the visible device count are skipped and recorded
+    # as such).
+    import hashlib
+
+    import jax
+    import numpy as np
+
+    from tensor2robot_tpu.envs import train_anakin
+
+    visible = len(jax.local_devices())
+    digests = {"pod_visible_devices": visible}
+    for count in (1, 2):
+      key = f"pod_params_sha256_devices_{count}"
+      if count > visible:
+        digests[key] = "skipped: not enough local devices"
+        continue
+      model = GraspingQModel(image_size=16, torso_filters=(8,),
+                             head_filters=(8,), dense_sizes=(16,),
+                             action_dim=2)
+      learner = QTOptLearner(model, cem_population=8,
+                             cem_iterations=1, cem_elites=2)
+      with tempfile.TemporaryDirectory() as pod_tmp:
+        state = train_anakin(
+            learner=learner, model_dir=pod_tmp, env_family="procgen",
+            num_envs=8, rollout_length=2, train_batches_per_iter=2,
+            batch_size=8, replay_capacity=64, max_train_steps=4,
+            log_every_steps=2, save_checkpoints_steps=4,
+            # count 1 runs the PR-9 jit program (num_devices=None),
+            # >=2 the pmap'd pod — the envs_bench leg's mapping.
+            num_devices=None if count == 1 else count,
+            seed=PROTOCOL_SEED)
+      digest = hashlib.sha256()
+      for leaf in jax.tree_util.tree_leaves(
+          jax.device_get(state.train_state.params)):
+        digest.update(np.ascontiguousarray(leaf).tobytes())
+      digests[key] = digest.hexdigest()
+    return digests
+
   a, b = one_pass(), one_pass()
   ea, eb = envs_pass(), envs_pass()
+  pa, pb = pod_pass(), pod_pass()
   a.update(ea)
+  a.update(pa)
   b.update(eb)
+  b.update(pb)
   ok = (a["sample_schedule_sha256"] == b["sample_schedule_sha256"]
         and a["action_stream_sha256"] == b["action_stream_sha256"]
-        and ea == eb)
+        and ea == eb and pa == pb)
   print(json.dumps({"artifact": "seedcheck", "reproducible": ok,
                     "run_a": a, "run_b": b}))
   if not ok:

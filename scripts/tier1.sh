@@ -43,7 +43,10 @@ echo "--- fleet bench smoke (bench.py --fleet --dry-run) ---"
 env JAX_PLATFORMS=cpu python bench.py --fleet --dry-run
 fleet_rc=$?
 
-echo "--- envs bench smoke (bench.py --envs --dry-run) ---"
+# The envs smoke includes the pod device-scaling leg: a REAL (tiny)
+# 2-virtual-device pmap'd collect-and-learn training next to the PR-9
+# single-device program (ISSUE 10).
+echo "--- envs bench smoke (bench.py --envs --dry-run; 2-device pod leg) ---"
 env JAX_PLATFORMS=cpu python bench.py --envs --dry-run
 envs_rc=$?
 

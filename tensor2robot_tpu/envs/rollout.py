@@ -229,6 +229,23 @@ def _build_env(env_family: str, model) -> FunctionalEnv:
                    "('pose', 'procgen') and no env was passed")
 
 
+# The pmap axis name of the pod-mode SPMD program (docs/ENVS.md).
+POD_AXIS = "pod"
+
+
+def _param_checksum(qstate) -> jax.Array:
+  """f32 digest of the online params: the cross-device agreement
+  probe. Replicated params produce bit-identical per-device sums
+  (same reduction order on every replica), so any drift — a missed
+  pmean, a per-device RNG leaking into the update — shows up as
+  checksum disagreement at the next log boundary."""
+  leaves = jax.tree_util.tree_leaves(qstate.train_state.params)
+  total = jnp.zeros((), jnp.float32)
+  for leaf in leaves:
+    total = total + jnp.sum(jnp.abs(leaf).astype(jnp.float32))
+  return total
+
+
 @gin.configurable
 def train_anakin(
     learner=gin.REQUIRED,
@@ -247,12 +264,14 @@ def train_anakin(
     epsilon: float = 0.1,
     cem_population: Optional[int] = None,
     cem_iterations: Optional[int] = None,
+    num_devices: Optional[int] = None,
+    shard_weight_update: bool = False,
     hooks: Iterable = (),
     seed: int = 0,
 ):
   """QT-Opt online training with fully-on-device collection.
 
-  One jitted iteration (traced ONCE — the jit-once pin in
+  One device iteration (traced ONCE — the jit-once pin in
   tests/test_envs.py):
 
     1. roll ``rollout_length`` steps of ``num_envs`` auto-resetting
@@ -263,10 +282,42 @@ def train_anakin(
     3. run ``train_batches_per_iter`` Bellman grad steps on uniform
        samples from the filled prefix.
 
+  ``num_devices`` selects the program topology:
+
+    * ``None`` (default) — the single-device jitted program (PR-9
+      semantics, unchanged and bitwise-preserved).
+    * ``0`` / ``D`` — POD MODE: the ENTIRE iteration is one pmap'd
+      SPMD program over all / the first ``D`` local devices
+      (Podracer's full Anakin diagram, PAPERS.md). Each device runs
+      ``num_envs / D`` envs feeding its OWN replay-ring shard (a
+      ``[D, ...]`` leaf of the donated carry) and samples its OWN
+      ``batch_size``-row Bellman batch (global batch ``D·batch_size``)
+      — gradients are `lax.pmean`'d over the axis before the
+      replicated Adam+Polyak update, so acting params stay EXACTLY
+      the training params on every device and ``param_refresh_lag``
+      remains 0 by construction at any device count. Per-device PRNG
+      folds by absolute step then device index (``D=1`` reduces to
+      the single-device key stream exactly). Hooks observe device-0
+      metrics (pmean'd where they are means, so they read as global);
+      each log boundary asserts a cross-device param-checksum
+      agreement. Checkpoints save the device-0 replica — resume
+      restores the learner exactly and re-replicates, and a pod
+      checkpoint resumes on any device count (including ``None``).
+
+  ``shard_weight_update=True`` composes the PR-6 ZeRO-style update
+  sharding where the mesh supports it: in the single-program path the
+  optimizer is wrapped with `optimizers.shard_weight_update` over
+  `parallel.mesh.create_mesh()` (moments live sharded across steps; a
+  1-device mesh is the pinned bitwise no-op). In pod mode each pmap
+  replica is a single-device program — there is no mesh for the GSPMD
+  constraint to act on — so the flag is ignored with a warning (the
+  pmean'd replicated update IS the pod path's distributed-update
+  story; see docs/ENVS.md).
+
   The iteration quantum is `train_qtopt`'s ``steps_per_dispatch``:
   every cadence must be a multiple of ``train_batches_per_iter``, and
   per-step PRNG folds by absolute step. Collection state (env states,
-  ring) is ephemeral — a resume restarts collection but restores the
+  rings) is ephemeral — a resume restarts collection but restores the
   learner exactly.
 
   Because acting params == training params inside one program,
@@ -287,15 +338,54 @@ def train_anakin(
       max_train_steps=max_train_steps)
   if env is None:
     env = _build_env(env_family, learner.model)
-  rows = num_envs * rollout_length
-  capacity = max(int(replay_capacity), batch_size, rows)
-  capacity = ((capacity + rows - 1) // rows) * rows
+
+  spmd = num_devices is not None
+  if spmd:
+    local = jax.local_devices()
+    d = len(local) if num_devices == 0 else int(num_devices)
+    if not 1 <= d <= len(local):
+      raise ValueError(
+          f"num_devices={num_devices} asks for {d} devices; "
+          f"{len(local)} local devices are visible")
+    devices = local[:d]
+    if num_envs % d:
+      raise ValueError(
+          f"num_envs={num_envs} must divide across {d} devices")
+  else:
+    d = 1
+    devices = None
+  per_env = num_envs // d
+  rows = num_envs * rollout_length      # total transitions / iteration
+  rows_d = per_env * rollout_length     # per-device ring segment
+  capacity = max(int(replay_capacity) // d, batch_size, rows_d)
+  capacity = ((capacity + rows_d - 1) // rows_d) * rows_d
   _check_wire_spec(learner)
   spec = learner.transition_specification().to_flat_dict()
 
   os.makedirs(model_dir, exist_ok=True)
   metric_logger = MetricLogger(model_dir)
   hook_list = HookList(list(hooks))
+
+  mesh = None
+  if shard_weight_update:
+    if spmd:
+      # Each pmap replica is a single-device program: the GSPMD
+      # sharding constraint `optimizers.shard_weight_update` rides on
+      # needs a jit+mesh program to act on. The pod path's
+      # distributed-update story is the pmean'd replicated update.
+      log.warning(
+          "shard_weight_update=True is ignored in pod mode "
+          "(num_devices=%s): pmap replicas are single-device "
+          "programs; use the single-program path on a mesh host for "
+          "ZeRO-style update sharding.", num_devices)
+    else:
+      from tensor2robot_tpu.models import optimizers as opt_lib
+      from tensor2robot_tpu.parallel import mesh as mesh_lib
+      mesh = mesh_lib.create_mesh()
+      # Wrap BEFORE the state exists so tx is final when the step
+      # traces (the train_qtopt wiring).
+      learner.model.wrap_optimizer(
+          lambda tx: opt_lib.shard_weight_update(tx, mesh))
 
   rng = jax.random.PRNGKey(seed)
   state = learner.create_state(rng, batch_size=2)
@@ -304,6 +394,12 @@ def train_anakin(
     log.info("Resuming anakin QT-Opt from step %d", resume_step)
     state = ckpt_lib.restore_state(model_dir, like=state,
                                    step=resume_step)
+  if mesh is not None:
+    from tensor2robot_tpu.parallel import sharding as sharding_lib
+    # Moments must STAY sharded across steps: place the carried state
+    # with the update sharding so the jitted iteration round-trips it.
+    state = jax.device_put(
+        state, sharding_lib.train_state_update_sharding(mesh, state))
   step = int(np.asarray(jax.device_get(state.step)))
   if k > 1 and step % k and step < max_train_steps:
     metric_logger.close()
@@ -313,17 +409,29 @@ def train_anakin(
         "would never align.")
 
   init_fn, collect_fn = make_collect_fn(
-      learner, env, num_envs, rollout_length, epsilon=epsilon,
+      learner, env, per_env, rollout_length, epsilon=epsilon,
       cem_population=cem_population, cem_iterations=cem_iterations)
-  env_states = jax.jit(init_fn)(jax.random.PRNGKey(seed + 2))
+  init_key = jax.random.PRNGKey(seed + 2)
+  if spmd:
+    # Device i resets its own env shard from fold_in(key, i); D=1
+    # uses the key itself so the shard equals the single-device batch.
+    init_keys = (init_key[None] if d == 1 else
+                 jnp.stack([jax.random.fold_in(init_key, i)
+                            for i in range(d)]))
+    env_states = jax.pmap(init_fn, devices=devices)(init_keys)
+  else:
+    env_states = jax.jit(init_fn)(init_key)
 
   if getattr(learner, "needs_calibration", False):
     # int8 CEM tower: activation scales are trace-time constants.
     # Calibrate on REAL rendered frames — the batched envs' first
-    # observations — before anything traces the quantized tower.
+    # observations (device-0 shard in pod mode) — before anything
+    # traces the quantized tower.
+    sample = min(per_env, 64)
     obs0 = jax.jit(jax.vmap(env.observe))(
-        jax.tree_util.tree_map(lambda x: x[:min(num_envs, 64)],
-                               env_states))
+        jax.tree_util.tree_map(
+            (lambda x: x[0, :sample]) if spmd else
+            (lambda x: x[:sample]), env_states))
     learner.calibrate(state, {
         "image": obs0["image"],
         "action": jax.random.uniform(
@@ -332,16 +440,23 @@ def train_anakin(
             minval=-1.0, maxval=1.0),
     })
 
+  lead = (d,) if spmd else ()
   replay = {
-      key: jnp.zeros((capacity,) + tuple(sp.shape),
+      key: jnp.zeros(lead + (capacity,) + tuple(sp.shape),
                      dtype=sp.dtype)
       for key, sp in spec.items()}
-  size0 = jnp.zeros((), jnp.int32)
-  ptr0 = jnp.zeros((), jnp.int32)
+  size0 = jnp.zeros(lead, jnp.int32)
+  ptr0 = jnp.zeros(lead, jnp.int32)
   step_rng = jax.random.PRNGKey(seed + 1)
+  axis = POD_AXIS if spmd else None
 
   def iteration(carry, key):
     qstate, states, ring, size, ptr = carry
+    if axis is not None and d > 1:
+      # Per-device key stream: the host folds by absolute step, each
+      # device folds its axis index on top. d is trace-time static,
+      # so D=1 keeps the single-device stream bit-exactly.
+      key = jax.random.fold_in(key, jax.lax.axis_index(axis))
     key_collect, _ = jax.random.split(key)
     states, batch = collect_fn(qstate, states, key_collect)
     ring = {
@@ -349,16 +464,21 @@ def train_anakin(
             ring[name], batch[name],
             (ptr,) + (0,) * (ring[name].ndim - 1))
         for name in ring}
-    size = jnp.minimum(size + rows, capacity)
-    ptr = (ptr + rows) % capacity
+    size = jnp.minimum(size + rows_d, capacity)
+    ptr = (ptr + rows_d) % capacity
 
     def train_body(st, _):
       base = jax.random.fold_in(step_rng, st.step)
       key_sample, key_net = jax.random.split(base)
+      if axis is not None and d > 1:
+        di = jax.lax.axis_index(axis)
+        key_sample = jax.random.fold_in(key_sample, di)
+        key_net = jax.random.fold_in(key_net, di)
       idx = jax.random.randint(key_sample, (batch_size,), 0, size)
       minibatch = TensorSpecStruct.from_flat_dict(
           {name: ring[name][idx] for name in ring})
-      return learner.train_step(st, minibatch, key_net)
+      return learner.train_step(st, minibatch, key_net,
+                                axis_name=axis)
 
     qstate, metrics_seq = jax.lax.scan(
         train_body, qstate, jnp.arange(k))
@@ -367,9 +487,25 @@ def train_anakin(
     metrics = jax.tree_util.tree_map(lambda m: m[-1], metrics_seq)
     metrics["collect_reward_mean"] = jnp.mean(batch["reward"])
     metrics["replay_fill"] = size.astype(jnp.float32) / capacity
+    if axis is not None:
+      metrics["collect_reward_mean"] = jax.lax.pmean(
+          metrics["collect_reward_mean"], axis)
+      metrics["param_checksum"] = _param_checksum(qstate)
     return (qstate, states, ring, size, ptr), metrics
 
-  anakin_step = jax.jit(iteration, donate_argnums=(0,))
+  if spmd:
+    anakin_step = jax.pmap(iteration, axis_name=POD_AXIS,
+                           devices=devices, in_axes=(0, None),
+                           donate_argnums=(0,))
+    state = jax.device_put_replicated(state, devices)
+  else:
+    anakin_step = jax.jit(iteration, donate_argnums=(0,))
+
+  def device0(tree):
+    """The device-0 replica view (identity in single-program mode)."""
+    if not spmd:
+      return tree
+    return jax.tree_util.tree_map(lambda x: x[0], tree)
 
   hook_list.begin(learner.model, model_dir)
   writer = ckpt_lib.CheckpointWriter(
@@ -385,13 +521,29 @@ def train_anakin(
           carry, jax.random.fold_in(iter_key, step))
       step += k
       steps_since_log += k
-      hook_list.after_step(step, metrics)
+      hook_list.after_step(step, device0(metrics))
       if step % log_every_steps == 0 or step == max_train_steps:
         scalars = jax.device_get(metrics)
+        if spmd:
+          checks = np.asarray(scalars.pop("param_checksum"))
+          if np.unique(checks).size != 1:
+            raise RuntimeError(
+                "pod replicas diverged: per-device param checksums "
+                f"{checks.tolist()} at step {step} — a gradient or "
+                "state update escaped the pmean")
+          scalars = {name: value[0] for name, value in
+                     scalars.items()}
         dt = time.time() - t_last
         iters = steps_since_log // k
         scalars["grad_steps_per_sec"] = steps_since_log / max(dt, 1e-9)
         scalars["env_steps_per_sec"] = (iters * rows) / max(dt, 1e-9)
+        if spmd:
+          scalars["devices"] = d
+          scalars["global_batch_size"] = d * batch_size
+          # Bellman THROUGHPUT: each optimizer step consumed one
+          # batch_size-row batch per device.
+          scalars["bellman_batches_per_sec"] = (
+              scalars["grad_steps_per_sec"] * d)
         # Zero BY CONSTRUCTION (acting params == training params in
         # one program) — logged so fleet-mode dashboards compare.
         scalars["param_refresh_lag_steps"] = 0.0
@@ -399,27 +551,28 @@ def train_anakin(
         t_last = time.time()
         steps_since_log = 0
       if step % save_checkpoints_steps == 0 or step == max_train_steps:
-        host_state = jax.device_get(carry[0])
+        host_state = jax.device_get(device0(carry[0]))
         writer.save(step, host_state,
                     params=host_state.train_state.params,
                     batch_stats=host_state.train_state.batch_stats)
         last_saved = step
-        hook_list.after_checkpoint(step, carry[0].train_state,
+        hook_list.after_checkpoint(step, device0(carry[0]).train_state,
                                    model_dir)
     if last_saved != step:
-      host_state = jax.device_get(carry[0])
+      host_state = jax.device_get(device0(carry[0]))
       writer.save(step, host_state,
                   params=host_state.train_state.params,
                   batch_stats=host_state.train_state.batch_stats)
-      hook_list.after_checkpoint(step, carry[0].train_state, model_dir)
+      hook_list.after_checkpoint(step, device0(carry[0]).train_state,
+                                 model_dir)
   finally:
     try:
-      hook_list.end(step, carry[0].train_state, model_dir)
+      hook_list.end(step, device0(carry[0]).train_state, model_dir)
     except Exception:  # noqa: BLE001 — don't mask the original error
       log.exception("hook end() failed during teardown")
     writer.close()
     metric_logger.close()
-  return carry[0]
+  return device0(carry[0])
 
 
 @gin.configurable

@@ -19,6 +19,7 @@ from tensor2robot_tpu.hooks.hook import Hook, HookList
 _EXPORTS = {
     "AsyncExportHook": "async_export_hook",
     "QTOptSuccessEvalHook": "success_eval_hook",
+    "ScenarioSuccessEvalHook": "success_eval_hook",
     "SuccessEvalHook": "success_eval_hook",
 }
 

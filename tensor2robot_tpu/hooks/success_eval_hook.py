@@ -96,6 +96,100 @@ class SuccessEvalHook(Hook):
 
 
 @gin.configurable
+class ScenarioSuccessEvalHook(Hook):
+  """Per-checkpoint PROCEDURAL-scenario robustness sweep (envs family).
+
+  The on-device counterpart of `QTOptSuccessEvalHook` for the
+  anakin/pod trainers: after each checkpoint it runs
+  `envs.evaluate_scenarios` — the seeded procgen sweep
+  `run_success_protocol envs` commits, success grouped by scenario
+  bucket (distractor count) with the random-policy baseline on the
+  SAME scenarios — against the checkpointed critic, then
+
+    * logs the headline metrics (overall + per-bucket success, random
+      baseline) to ``metrics_<tag>.jsonl`` next to the train metrics,
+    * APPENDS one success-protocol-shaped record per checkpoint to
+      ``artifacts_path`` (default
+      ``<model_dir>/success_protocol/scenarios_by_checkpoint.jsonl``)
+      — the `qtopt_envs_scenarios.jsonl` row format plus step
+      provenance, so per-checkpoint robustness trajectories land in
+      the same artifact family as the end-of-training protocol run.
+
+  The sweep is seeded: every checkpoint is scored on the SAME
+  scenario set, so the per-bucket trajectory measures the policy, not
+  scenario-sampling noise. `train_anakin` hands hooks the device-0
+  critic TrainState; `build_policy` accepts it directly.
+  """
+
+  def __init__(self,
+               learner=None,
+               env=None,
+               num_scenarios: int = 256,
+               seed: int = 0,
+               cem_population: Optional[int] = None,
+               cem_iterations: Optional[int] = None,
+               tag: str = "scenario_eval",
+               every_n_checkpoints: int = 1,
+               artifacts_path: Optional[str] = None):
+    self._learner = learner
+    self._env = env
+    self._num_scenarios = int(num_scenarios)
+    self._seed = int(seed)
+    self._cem_population = cem_population
+    self._cem_iterations = cem_iterations
+    self._tag = tag
+    self._every = max(1, every_n_checkpoints)
+    self._artifacts_path = artifacts_path
+    self._checkpoints_seen = 0
+
+  def begin(self, model, model_dir: str) -> None:
+    self._checkpoints_seen = 0
+
+  def after_checkpoint(self, step: int, state: Any,
+                       model_dir: str) -> None:
+    self._checkpoints_seen += 1
+    if (self._checkpoints_seen - 1) % self._every:
+      return
+    import json
+    import os
+
+    from tensor2robot_tpu.envs import evaluate_scenarios
+
+    sweep = evaluate_scenarios(
+        self._learner, state, env=self._env,
+        num_scenarios=self._num_scenarios, seed=self._seed,
+        cem_population=self._cem_population,
+        cem_iterations=self._cem_iterations)
+    metrics = {
+        "success_rate": sweep["success_rate"],
+        "random_baseline_success_rate":
+            sweep["random_baseline_success_rate"],
+        "num_scenarios": sweep["num_scenarios"],
+    }
+    for bucket, stats in sorted(sweep["per_bucket"].items()):
+      if stats["success_rate"] is not None:
+        metrics[f"bucket_{bucket}_success_rate"] = \
+            stats["success_rate"]
+    _write_metrics(model_dir, self._tag, step, metrics)
+
+    path = self._artifacts_path or os.path.join(
+        model_dir, "success_protocol", "scenarios_by_checkpoint.jsonl")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    record = {
+        "phase": "checkpoint_sweep",
+        "step": int(step),
+        "scenario_family": type(self._env).__name__
+                           if self._env is not None else "procgen",
+        **{k: sweep[k] for k in (
+            "success_rate", "random_baseline_success_rate",
+            "num_scenarios", "per_bucket", "action_digest",
+            "scenario_digest")},
+    }
+    with open(path, "a") as f:
+      f.write(json.dumps(record) + "\n")
+
+
+@gin.configurable
 class QTOptSuccessEvalHook(Hook):
   """CEM-policy grasp success per checkpoint (QT-Opt loop).
 

@@ -349,10 +349,29 @@ class AbstractT2RModel(ModelInterface):
                           static_argnums=(5,))
 
   def train_step(self, state: TrainState, features, labels,
-                 rng: jax.Array) -> Tuple[TrainState, Dict[str, jax.Array]]:
+                 rng: jax.Array, axis_name: Optional[str] = None
+                 ) -> Tuple[TrainState, Dict[str, jax.Array]]:
+    """One optimizer step on `features`/`labels`.
+
+    `axis_name` (trace-time static) selects the SPMD data-parallel
+    form: inside a `pmap`/`shard_map` over that axis, per-device
+    gradients are `lax.pmean`'d before the optimizer — every replica
+    then applies the identical update, so replicated params STAY
+    replicated (the Podracer/Anakin pod contract, docs/ENVS.md).
+    Batch-norm statistics and the reported metrics are pmean'd the
+    same way (cross-replica batch stats; device-0 metrics are global
+    means). `axis_name=None` (the default) is the unchanged
+    single-program step.
+    """
     grad_fn = jax.value_and_grad(self._loss_for_grad(), has_aux=True)
     (loss, (scalars, new_stats)), grads = grad_fn(
         state.params, state.batch_stats, features, labels, rng, Mode.TRAIN)
+    if axis_name is not None:
+      grads = jax.lax.pmean(grads, axis_name)
+      loss = jax.lax.pmean(loss, axis_name)
+      scalars = jax.lax.pmean(scalars, axis_name)
+      if new_stats:
+        new_stats = jax.lax.pmean(new_stats, axis_name)
     updates, new_opt_state = self.tx.update(grads, state.opt_state,
                                             state.params)
     new_params = optax.apply_updates(state.params, updates)

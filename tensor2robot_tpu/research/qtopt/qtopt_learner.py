@@ -28,7 +28,6 @@ from typing import Any, Dict, Optional, Tuple
 import flax
 import jax
 import jax.numpy as jnp
-import optax
 
 from tensor2robot_tpu import config as gin
 from tensor2robot_tpu.data.abstract_input_generator import Mode
@@ -37,6 +36,27 @@ from tensor2robot_tpu.models.critic_model import Q_VALUE
 from tensor2robot_tpu.research.qtopt import cem
 from tensor2robot_tpu.research.qtopt.t2r_models import GraspingQModel
 from tensor2robot_tpu.specs import TensorSpecStruct
+
+
+def _polyak(tau, new, old):
+  """Polyak average in the contraction-stable form `old+tau·(new-old)`.
+
+  `optax.incremental_update`'s `tau·new + (1-tau)·old` leaves an
+  inexact multiply feeding an add, and XLA backends contract that
+  pair into an FMA (or don't) per compiled module — jit- and
+  pmap-compiled modules of the SAME jaxpr measurably disagree by
+  1 ulp on XLA:CPU, and HLO `optimization_barrier`s don't survive to
+  LLVM to stop it. This form has a single multiply on the difference;
+  when ``tau`` is a power of two (2^-k) that product is EXACT, so the
+  FMA and non-FMA contractions round identically and the update is
+  bit-stable across compilation modes regardless of backend ISA. (The
+  pod-vs-single-program bitwise pin in tests/test_envs.py removes the
+  remaining backward-pass contraction ambiguity by pinning under an
+  FMA-less `--xla_cpu_max_isa`; this form keeps the default-ISA drift
+  to 1 ulp per step.) For non-pow2 tau the value matches the textbook
+  average to 1 ulp.
+  """
+  return old + tau * (new - old)
 
 
 @flax.struct.dataclass
@@ -274,12 +294,21 @@ class QTOptLearner:
   # ---- the fused train step ----
 
   def train_step(self, state: QTOptState, transitions: TensorSpecStruct,
-                 rng: jax.Array) -> Tuple[QTOptState,
-                                          Dict[str, jax.Array]]:
+                 rng: jax.Array, axis_name: Optional[str] = None
+                 ) -> Tuple[QTOptState, Dict[str, jax.Array]]:
     """One Bellman update on a batch of transitions.
 
     transitions (flat struct): image, action [A], reward [1], done [1],
     next_image (+ any extra state features prefixed next_).
+
+    `axis_name` (trace-time static) is the SPMD pod form: each device
+    computes Bellman targets and gradients on its OWN transition
+    batch, gradients are `lax.pmean`'d over the axis before the Adam
+    update (the model's `train_step` seam), and the Polyak target
+    update then runs on identical post-update params everywhere — so
+    the replicated learner state stays replicated by construction.
+    The q_next/target metrics are pmean'd too (device-0 reports the
+    global means).
     """
     flat = transitions.to_flat_dict()
     rng_cem, rng_net = jax.random.split(rng)
@@ -308,11 +337,18 @@ class QTOptLearner:
     labels = TensorSpecStruct.from_flat_dict(
         {"target_q": target[:, None]})
     new_ts, metrics = self._model.train_step(ts, features, labels,
-                                             rng_net)
-    new_target = optax.incremental_update(
-        new_ts.params, state.target_params, self._tau)
+                                             rng_net,
+                                             axis_name=axis_name)
+    new_target = jax.tree_util.tree_map(
+        functools.partial(_polyak, self._tau),
+        new_ts.params, state.target_params)
     metrics["q_next_mean"] = jnp.mean(q_next)
     metrics["target_mean"] = jnp.mean(target)
+    if axis_name is not None:
+      metrics["q_next_mean"] = jax.lax.pmean(metrics["q_next_mean"],
+                                             axis_name)
+      metrics["target_mean"] = jax.lax.pmean(metrics["target_mean"],
+                                             axis_name)
     return QTOptState(train_state=new_ts,
                       target_params=new_target), metrics
 

@@ -362,6 +362,336 @@ class TestTrainAnakin:
                    max_train_steps=1, log_every_steps=1,
                    save_checkpoints_steps=1)
 
+class TestPodAnakin:
+  """Pod mode (ISSUE 10): the ENTIRE collect-and-learn iteration as
+  one pmap'd SPMD program — per-device env shards and replay rings,
+  per-device Bellman batches, gradients pmean'd over the device axis
+  before the replicated Adam+Polyak update."""
+
+  POD_KWARGS = dict(
+      env_family="pose", num_envs=16, rollout_length=2,
+      train_batches_per_iter=4, batch_size=16, replay_capacity=128,
+      max_train_steps=16, log_every_steps=8,
+      save_checkpoints_steps=16, seed=0)
+
+  def test_pod_smoke_metrics_and_exact_resume(self, tmp_path):
+    learner = _tiny_learner()
+    state = train_anakin(learner=learner, model_dir=str(tmp_path),
+                         num_devices=2, **self.POD_KWARGS)
+    # Returned state is the unreplicated device-0 replica.
+    assert int(state.step) == 16
+    rows = [json.loads(line)
+            for line in open(tmp_path / "metrics_train.jsonl")]
+    assert rows
+    for row in rows:
+      # Zero by construction at ANY device count: acting params ARE
+      # the training params inside the one pmap'd program.
+      assert row["param_refresh_lag_steps"] == 0.0
+      assert row["devices"] == 2
+      assert row["global_batch_size"] == 32
+      # Bellman throughput counts one per-device batch per step.
+      assert row["bellman_batches_per_sec"] == pytest.approx(
+          2 * row["grad_steps_per_sec"])
+      assert 0.0 <= row["replay_fill"] <= 1.0
+    # (The cross-device param-checksum agreement asserted at every log
+    # boundary inside the loop did not fire — replicas stayed equal.)
+    from tensor2robot_tpu.utils import checkpoints as ckpt_lib
+    assert ckpt_lib.latest_step(str(tmp_path)) == 16
+    # Resume restores the learner exactly: a second call at the same
+    # max step trains zero iterations and returns the checkpoint.
+    resumed = train_anakin(learner=learner, model_dir=str(tmp_path),
+                           num_devices=2, **self.POD_KWARGS)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(jax.device_get(a)),
+            np.asarray(jax.device_get(b))),
+        state.train_state.params, resumed.train_state.params)
+
+  def test_pmean_parity_and_replication_invariant(self):
+    """Statistical pin of the pmean'd update: a 2-device pmap step
+    over two half batches equals the explicitly-averaged per-half
+    gradients applied once (the DEFINITION of the pmean'd update —
+    per-device batch-norm and loss semantics included), and the
+    per-device results are bitwise IDENTICAL across the axis (the
+    replication invariant pmean exists to preserve)."""
+    import optax
+    from tensor2robot_tpu.data.abstract_input_generator import Mode
+    from tensor2robot_tpu.models import optimizers as opt_lib
+    from tensor2robot_tpu.specs import (
+        TensorSpecStruct,
+        make_random_tensors,
+    )
+
+    # SGD, not Adam: the parity bound must survive the optimizer.
+    # Adam's first step is ~sign(g)·lr, which flips on near-zero
+    # gradients under any last-ulp noise; SGD keeps the update linear
+    # in the pmean'd gradient so the tolerance is meaningful.
+    model = GraspingQModel(
+        image_size=16, torso_filters=(8,), head_filters=(8,),
+        dense_sizes=(16,), action_dim=2,
+        create_optimizer_fn=lambda: opt_lib.create_optimizer(
+            optimizer_name="sgd", learning_rate=0.1))
+    state = model.create_train_state(jax.random.PRNGKey(0),
+                                     batch_size=2)
+    feats = make_random_tensors(
+        model.get_feature_specification(Mode.TRAIN), batch_size=32,
+        seed=1)
+    feats = {k: jnp.asarray(v) for k, v in feats.items()}
+    labels = {"target_q": jax.random.uniform(jax.random.PRNGKey(2),
+                                             (32, 1))}
+    rng = jax.random.PRNGKey(3)
+    struct = TensorSpecStruct.from_flat_dict
+
+    devices = jax.local_devices()[:2]
+    split = lambda t: jax.tree_util.tree_map(  # noqa: E731
+        lambda x: x.reshape((2, 16) + x.shape[1:]), t)
+    pod_step = jax.pmap(
+        lambda s, f, l, r: model.train_step(
+            s, struct(f), struct(l), r, axis_name="pod"),
+        axis_name="pod", devices=devices, in_axes=(0, 0, 0, None))
+    got, got_metrics = pod_step(
+        jax.device_put_replicated(state, devices), split(feats),
+        split(labels), rng)
+
+    # Replication invariant: both replicas hold bitwise-equal params.
+    for leaf in jax.tree_util.tree_leaves(
+        jax.device_get(got.params)):
+      np.testing.assert_array_equal(np.asarray(leaf)[0],
+                                    np.asarray(leaf)[1])
+
+    # Reference: per-half gradients (same per-device BN/loss
+    # semantics), explicitly averaged, applied once.
+    def half(f, l):
+      grad_fn = jax.value_and_grad(model.loss_fn, has_aux=True)
+      (loss, (_, stats)), grads = grad_fn(
+          state.params, state.batch_stats, struct(f), struct(l),
+          rng, Mode.TRAIN)
+      return loss, stats, grads
+    half = jax.jit(half)
+    halves = [jax.tree_util.tree_map(lambda x, i=i: x[i * 16:
+                                                      (i + 1) * 16],
+                                     t)
+              for t in (feats, labels) for i in (0, 1)]
+    l0, s0, g0 = half(halves[0], halves[2])
+    l1, s1, g1 = half(halves[1], halves[3])
+    mean2 = lambda a, b: jax.tree_util.tree_map(  # noqa: E731
+        lambda x, y: (x + y) / 2, a, b)
+
+    @jax.jit
+    def apply(grads):
+      updates, _ = model.tx.update(grads, state.opt_state,
+                                   state.params)
+      return optax.apply_updates(state.params, updates)
+
+    ref_params = apply(mean2(g0, g1))
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(jax.device_get(a)),
+            np.asarray(jax.device_get(b))[0], rtol=1e-4, atol=1e-5),
+        ref_params, got.params)
+    # Cross-replica batch stats: pmean of the per-half BN statistics.
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(jax.device_get(a)),
+            np.asarray(jax.device_get(b))[0], rtol=1e-4, atol=1e-5),
+        mean2(s0, s1), got.batch_stats)
+    # Metrics are pmean'd: device-0 reports the global mean loss.
+    np.testing.assert_allclose(float(got_metrics["loss"][0]),
+                               (float(l0) + float(l1)) / 2,
+                               rtol=1e-4, atol=1e-5)
+
+  def test_pod_validates_devices_and_divisibility(self, tmp_path):
+    learner = _tiny_learner()
+    with pytest.raises(ValueError, match="divide"):
+      train_anakin(learner=learner, model_dir=str(tmp_path),
+                   num_envs=6, rollout_length=1, num_devices=4,
+                   train_batches_per_iter=1, batch_size=4,
+                   max_train_steps=1, log_every_steps=1,
+                   save_checkpoints_steps=1)
+    with pytest.raises(ValueError, match="devices are visible"):
+      train_anakin(learner=learner, model_dir=str(tmp_path),
+                   num_envs=64, rollout_length=1, num_devices=64,
+                   train_batches_per_iter=1, batch_size=4,
+                   max_train_steps=1, log_every_steps=1,
+                   save_checkpoints_steps=1)
+
+  def test_pod_ignores_shard_weight_update_with_warning(
+      self, tmp_path, caplog):
+    """pmap replicas are single-device programs: the GSPMD constraint
+    has no mesh to act on, so pod mode warns and proceeds."""
+    import logging
+
+    learner = _tiny_learner()
+    with caplog.at_level(logging.WARNING,
+                         logger="tensor2robot_tpu.envs.rollout"):
+      state = train_anakin(
+          learner=learner, model_dir=str(tmp_path), env_family="pose",
+          num_envs=4, rollout_length=1, train_batches_per_iter=1,
+          batch_size=4, replay_capacity=16, max_train_steps=2,
+          log_every_steps=2, save_checkpoints_steps=2, num_devices=2,
+          shard_weight_update=True, seed=0)
+    assert int(state.step) == 2
+    assert any("shard_weight_update" in r.message
+               for r in caplog.records)
+
+  def test_single_program_shard_weight_update_smoke(self, tmp_path):
+    """The PR-6 composition on the jit+mesh path: a short single-
+    program run with the flag on completes and checkpoints on the
+    8-virtual-device mesh (moments constrained by the update
+    sharding; 1-device meshes are the pinned bitwise no-op)."""
+    learner = _tiny_learner()
+    state = train_anakin(
+        learner=learner, model_dir=str(tmp_path), env_family="pose",
+        num_envs=8, rollout_length=1, train_batches_per_iter=2,
+        batch_size=8, replay_capacity=32, max_train_steps=4,
+        log_every_steps=2, save_checkpoints_steps=4,
+        shard_weight_update=True, seed=0)
+    assert int(np.asarray(jax.device_get(state.step))) == 4
+
+  @pytest.mark.slow
+  def test_pod_one_device_bitwise_vs_single_program(self):
+    """THE equivalence pin: at D=1 the pmap'd pod program reproduces
+    the PR-9 single-device jitted program BITWISE — same PRNG
+    streams, same ring schedule, same updates. XLA:CPU's LLVM
+    backend makes per-module FMA-contraction choices (jit- and
+    pmap-compiled modules of the same jaxpr drift by 1 ulp/step in
+    the conv/dense backward), so the pin runs in a subprocess under
+    an FMA-less ISA cap — program equivalence is exactly what
+    remains once the compiler's contraction freedom is removed."""
+    import os
+    import subprocess
+    import sys
+    import textwrap
+
+    script = textwrap.dedent("""
+        import tempfile
+        import numpy as np, jax
+        from tensor2robot_tpu.envs import train_anakin
+        from tensor2robot_tpu.research.qtopt import (
+            GraspingQModel, QTOptLearner)
+
+        def tiny():
+          model = GraspingQModel(image_size=16, torso_filters=(8,),
+                                 head_filters=(8,), dense_sizes=(16,),
+                                 action_dim=2)
+          return QTOptLearner(model, cem_population=8,
+                              cem_iterations=1, cem_elites=2)
+
+        kwargs = dict(env_family="pose", num_envs=16,
+                      rollout_length=2, train_batches_per_iter=4,
+                      batch_size=16, replay_capacity=128,
+                      max_train_steps=16, log_every_steps=8,
+                      save_checkpoints_steps=16, seed=0)
+        with tempfile.TemporaryDirectory() as t1:
+          single = train_anakin(learner=tiny(), model_dir=t1, **kwargs)
+        with tempfile.TemporaryDirectory() as t2:
+          pod = train_anakin(learner=tiny(), model_dir=t2,
+                             num_devices=1, **kwargs)
+        for tag, a, b in (
+            ("params", single.train_state.params,
+             pod.train_state.params),
+            ("batch_stats", single.train_state.batch_stats,
+             pod.train_state.batch_stats),
+            ("opt_state", single.train_state.opt_state,
+             pod.train_state.opt_state),
+            ("target_params", single.target_params,
+             pod.target_params)):
+          la = jax.tree_util.tree_leaves(jax.device_get(a))
+          lb = jax.tree_util.tree_leaves(jax.device_get(b))
+          for x, y in zip(la, lb):
+            assert np.array_equal(np.asarray(x), np.asarray(y)), tag
+        print("BITWISE_OK")
+    """)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=2 "
+                        "--xla_cpu_max_isa=SSE4_2")
+    out = subprocess.run(
+        [sys.executable, "-c", script], env=env, capture_output=True,
+        text=True, timeout=900,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert out.returncode == 0, out.stderr[-4000:]
+    assert "BITWISE_OK" in out.stdout
+
+  @pytest.mark.slow
+  def test_pod_two_devices_close_to_single_program(self, tmp_path):
+    """Device-count invariance, statistically pinned end to end: a
+    2-device pod run (same total envs, same per-device batch) stays
+    a working learner — finite losses, full replay ring, and a final
+    collect reward in the same regime as the single-program run."""
+    learner = _tiny_learner()
+    single = train_anakin(
+        learner=learner, model_dir=str(tmp_path / "single"),
+        **self.POD_KWARGS)
+    pod = train_anakin(
+        learner=learner, model_dir=str(tmp_path / "pod"),
+        num_devices=2, **self.POD_KWARGS)
+    rows_s = [json.loads(line) for line in
+              open(tmp_path / "single" / "metrics_train.jsonl")]
+    rows_p = [json.loads(line) for line in
+              open(tmp_path / "pod" / "metrics_train.jsonl")]
+    assert int(single.step) == int(pod.step) == 16
+    assert np.isfinite(rows_p[-1]["loss"])
+    # Same collection volume per iteration: both fill the ring at the
+    # same rate even though the pod splits it across two shards.
+    assert rows_p[-1]["replay_fill"] == rows_s[-1]["replay_fill"]
+    # Both learners' Bellman targets live on the same sigmoid scale.
+    assert abs(rows_p[-1]["target_mean"]
+               - rows_s[-1]["target_mean"]) < 0.25
+
+
+class TestScenarioSuccessEvalHook:
+  """Per-checkpoint procgen robustness sweeps land in the metrics log
+  AND the success-protocol artifact family (ISSUE 10 satellite)."""
+
+  def test_checkpoint_sweep_logs_and_appends(self, tmp_path):
+    from tensor2robot_tpu.hooks import ScenarioSuccessEvalHook
+
+    learner = _tiny_learner()
+    state = learner.create_state(RNG)
+    env = ProcGenGraspEnv(image_size=16, action_dim=2)
+    hook = ScenarioSuccessEvalHook(learner=learner, env=env,
+                                   num_scenarios=32, seed=3)
+    hook.begin(learner.model, str(tmp_path))
+    # train_anakin hands hooks the device-0 critic TrainState.
+    hook.after_checkpoint(500, state.train_state, str(tmp_path))
+    hook.after_checkpoint(1000, state.train_state, str(tmp_path))
+
+    rows = [json.loads(line) for line in
+            open(tmp_path / "metrics_scenario_eval.jsonl")]
+    assert [r["step"] for r in rows] == [500, 1000]
+    assert 0.0 <= rows[0]["success_rate"] <= 1.0
+    assert "random_baseline_success_rate" in rows[0]
+    assert any(k.startswith("bucket_") for k in rows[0])
+
+    art = tmp_path / "success_protocol" / "scenarios_by_checkpoint.jsonl"
+    records = [json.loads(line) for line in open(art)]
+    assert [r["step"] for r in records] == [500, 1000]
+    assert records[0]["phase"] == "checkpoint_sweep"
+    assert records[0]["per_bucket"]
+    # Seeded sweep: every checkpoint scored on the SAME scenario set.
+    assert (records[0]["scenario_digest"]
+            == records[1]["scenario_digest"])
+
+  def test_every_n_checkpoints_thins(self, tmp_path):
+    from tensor2robot_tpu.hooks import ScenarioSuccessEvalHook
+
+    learner = _tiny_learner()
+    state = learner.create_state(RNG)
+    hook = ScenarioSuccessEvalHook(
+        learner=learner, env=ProcGenGraspEnv(image_size=16,
+                                             action_dim=2),
+        num_scenarios=16, seed=1, every_n_checkpoints=2)
+    hook.begin(learner.model, str(tmp_path))
+    for step in (100, 200, 300):
+      hook.after_checkpoint(step, state.train_state, str(tmp_path))
+    rows = [json.loads(line) for line in
+            open(tmp_path / "metrics_scenario_eval.jsonl")]
+    assert [r["step"] for r in rows] == [100, 300]
+
+
+class TestTrainAnakinLearning:
+
   @pytest.mark.slow
   def test_anakin_learns_pose_bandit(self, tmp_path):
     # Training-quality check (slow lane): on-device online QT-Opt
