@@ -121,6 +121,18 @@ Usage: python bench.py [--paper] [--profile DIR] [--input] [--replay]
              speedup vs the committed fleet env_steps_per_sec
              baseline recorded. With --dry-run: tiny env/model, no
              BENCH_DETAIL.json write — the tier-1 smoke.
+  --telemetry  the telemetry-plane axis (telemetry section): tracing
+             overhead (steps/s with the span tracer on vs off on the
+             tier-1 qtopt smoke, <2% gate) AND a real 2-actor fleet
+             whose per-process trace_<role>.jsonl files merge into
+             ONE Chrome-trace timeline (clock offsets from the RPC
+             handshake) asserted to contain spans from the learner,
+             host, and both actors; the merged timeline is committed
+             to artifacts/telemetry/fleet_trace.json.gz, and the
+             orchestrator's aggregated fleet_metrics.jsonl records
+             are schema-validated. With --dry-run: same legs at smoke
+             scale, no detail-file or artifact write — the tier-1
+             smoke.
   --serving  the low-latency serving axis (serving_latency section):
              CEM action-selection latency at batch=1 and batch=8
              through the bucketed AOT engine (p50/p95 over ≥100
@@ -1752,6 +1764,184 @@ def bench_envs(dry_run: bool = False):
   return json.loads(out.stdout.strip().splitlines()[-1])
 
 
+def _telemetry_overhead_probe(dry_run: bool = False):
+  """Tracing on vs OFF on the tier-1 qtopt smoke: steps/s A/B.
+
+  Both arms run the SAME tiny in-process `train_qtopt` loop (fresh
+  model_dir each, prefill_random, K=1) and read the LAST log window's
+  `grad_steps_per_sec` — the first window absorbs the trace+compile,
+  the last is steady state. Arms alternate and each takes its BEST of
+  N (the repo's bench methodology: max throughput reflects machine
+  capability, and best-of converges through scheduler noise — single
+  windows on this host swing ±7%, an order of magnitude above the
+  ~0.1% true span cost). The <2% gate (ISSUE 11) is enforced by the
+  caller on the full run only.
+  """
+  import shutil
+  import tempfile
+
+  from tensor2robot_tpu import telemetry
+  from tensor2robot_tpu.research.qtopt import (
+      GraspingQModel,
+      QTOptLearner,
+  )
+  from tensor2robot_tpu.research.qtopt.train_qtopt import train_qtopt
+  from tensor2robot_tpu.telemetry.records import read_records
+
+  steps = 120 if dry_run else 320
+  log_every = steps // 2
+  trials = 1 if dry_run else 6
+
+  def run_once(tracing: bool) -> float:
+    model_dir = tempfile.mkdtemp(prefix="t2r_tel_overhead_")
+    trace_dir = os.path.join(model_dir, "telemetry")
+    try:
+      if tracing:
+        telemetry.configure("trainer", trace_dir=trace_dir)
+      else:
+        telemetry.configure("trainer", enabled=False)
+      learner = QTOptLearner(
+          GraspingQModel(image_size=16, torso_filters=(8,),
+                         head_filters=(8,), dense_sizes=(16,),
+                         action_dim=2),
+          cem_population=8, cem_iterations=1, cem_elites=2)
+      train_qtopt(learner=learner, model_dir=model_dir,
+                  prefill_random=True, max_train_steps=steps,
+                  batch_size=16, log_every_steps=log_every,
+                  save_checkpoints_steps=steps, seed=0)
+      records = read_records(
+          os.path.join(model_dir, "metrics_train.jsonl"))
+      return float(records[-1]["grad_steps_per_sec"])
+    finally:
+      shutil.rmtree(model_dir, ignore_errors=True)
+
+  rates = {True: [], False: []}
+  for _ in range(trials):
+    for tracing in (False, True):  # alternate: noise hits both arms
+      rates[tracing].append(run_once(tracing))
+  telemetry.core.reset_for_tests()  # leave the process unconfigured
+  on, off = max(rates[True]), max(rates[False])
+  return {
+      "steps_per_sec_tracing_off": round(off, 2),
+      "steps_per_sec_tracing_on": round(on, 2),
+      # Positive = tracing costs throughput; clamp tiny negative noise
+      # at reporting time, not in the gate inputs.
+      "telemetry_overhead": round(1.0 - on / max(off, 1e-9), 4),
+      "trials_per_arm": trials,
+      "probe_steps": steps,
+  }
+
+
+def bench_telemetry(dry_run: bool = False):
+  """The --telemetry axis: measured tracing overhead + the 2-actor
+  fleet trace-merge smoke (ISSUE 11).
+
+  Two legs:
+
+    * OVERHEAD — `_telemetry_overhead_probe`: the tier-1 qtopt smoke
+      with tracing on vs off; `telemetry_overhead` must stay <2% of
+      steps/s (gated on the full run; the dry-run records it).
+    * TRACE MERGE — a real (tiny) 2-actor fleet runs with the
+      telemetry plane on, then `telemetry.merge` folds every process's
+      `trace_<role>.jsonl` into ONE Chrome-trace timeline, asserted to
+      contain spans from the learner, the host, and BOTH actors. The
+      full run commits the merged timeline to
+      `artifacts/telemetry/fleet_trace.json.gz`; the dry-run merges into
+      the throwaway model_dir (tier-1 must not touch committed
+      artifacts).
+  """
+  import shutil
+  import tempfile
+
+  from tensor2robot_tpu.fleet import Fleet, FleetConfig
+  from tensor2robot_tpu.telemetry import merge as merge_lib
+  from tensor2robot_tpu.telemetry.records import validate_record
+
+  overhead = _telemetry_overhead_probe(dry_run)
+  if not dry_run and overhead["telemetry_overhead"] >= 0.02:
+    # Gate BEFORE the fleet run and before anything committed is
+    # touched: a failing axis must never leave side effects behind.
+    print(json.dumps({
+        "error": "telemetry_overhead_gate",
+        "telemetry_overhead": overhead["telemetry_overhead"],
+        "note": "tracing on vs off cost >=2% steps/s on the smoke; "
+                "treat like a failing test",
+    }), file=sys.stderr)
+    raise SystemExit(1)
+
+  # Both modes use the tier-1-sized fleet (the smoke IS the artifact
+  # source: the merged-timeline claim is about coverage, not scale).
+  config = FleetConfig(
+      num_actors=2, env="mujoco_pose", image_size=16, action_dim=2,
+      torso_filters=(8,), head_filters=(8,), dense_sizes=(16,),
+      cem_population=8, cem_iterations=1, cem_elites=2,
+      batch_size=16, max_train_steps=24 if dry_run else 48,
+      min_replay_size=32, publish_every_steps=8, log_every_steps=8,
+      batch_episodes=8, serve_max_batch=4, replay_capacity=512,
+      replay_shards=2, heartbeat_timeout_secs=0.0,
+      launch_timeout_secs=240.0, run_timeout_secs=600.0,
+      telemetry_poll_secs=2.0, seed=0)
+  model_dir = tempfile.mkdtemp(prefix="t2r_telemetry_bench_")
+  try:
+    fleet = Fleet(config, model_dir)
+    result = fleet.run()
+    trace_dir = os.path.join(model_dir, "telemetry")
+    # Merge into the THROWAWAY dir first; the committed artifact is
+    # only replaced after every assertion below passes (a failing run
+    # must never mutate committed state).
+    staged = os.path.join(
+        trace_dir, "merged_trace.json.gz" if not dry_run
+        else "merged_trace.json")
+    trace = merge_lib.merge_traces(trace_dir, out_path=staged)
+    # The coverage gate checks roles WITH SPANS: a process that merely
+    # configured tracing (meta line) and wedged must not pass.
+    roles = set(merge_lib.roles_with_spans(trace))
+    required = {"host", "learner", "actor-0", "actor-1"}
+    missing = required - roles
+    if missing:
+      raise SystemExit(
+          f"telemetry merge: timeline is missing spans from roles "
+          f"{sorted(missing)} (found {sorted(roles)})")
+    # The orchestrator's aggregated fleet-wide view, schema-validated
+    # (one parse: validate the raw envelopes directly).
+    fleet_metrics_path = os.path.join(trace_dir, "fleet_metrics.jsonl")
+    with open(fleet_metrics_path) as f:
+      aggregated = [json.loads(line) for line in f if line.strip()]
+    for record in aggregated:
+      problems = validate_record(record)
+      if problems:
+        raise SystemExit(
+            f"fleet_metrics.jsonl record failed the envelope "
+            f"schema: {problems}")
+    if not dry_run:
+      out_path = os.path.join(
+          os.path.dirname(os.path.abspath(__file__)), "artifacts",
+          "telemetry", "fleet_trace.json.gz")
+      os.makedirs(os.path.dirname(out_path), exist_ok=True)
+      shutil.copyfile(staged, out_path)
+  finally:
+    shutil.rmtree(model_dir, ignore_errors=True)
+
+  section = {
+      "device_kind": jax.devices()[0].device_kind,
+      "host_cores": os.cpu_count(),
+      **overhead,
+      "merged_roles": sorted(roles),
+      "merged_spans": trace["metadata"]["span_count"],
+      "aggregated_metric_records": len(aggregated),
+      "fleet_env_steps_per_sec": round(result.env_steps_per_sec, 1),
+      "artifact": (None if dry_run
+                   else "artifacts/telemetry/fleet_trace.json.gz"),
+      "note": (
+          "merged Chrome-trace timeline from a real 2-actor fleet "
+          "(host/learner/actors/orchestrator processes, clock offsets "
+          "from the RPC handshake); overhead is steps/s tracing-on vs "
+          "-off on the tier-1 qtopt smoke, best-of-N per arm, gated "
+          "<2% before anything committed is touched"),
+  }
+  return section
+
+
 def bench_coldstart(dry_run: bool = False):
   """The restart-latency axis: cold-cache vs warm-cache subprocesses.
 
@@ -2334,6 +2524,24 @@ def main():
             smoke["pose_parity"]["image_bitwise_equal_noise0"],
     }))
     return
+  if "--telemetry" in args and "--dry-run" in args:
+    # Tier-1 smoke of the telemetry plane: the tracing-overhead A/B
+    # probe AND a real (tiny) 2-actor fleet whose per-process traces
+    # merge into one timeline with spans from every role — NO
+    # detail-file write, NO committed-artifact write.
+    smoke = bench_telemetry(dry_run=True)
+    print(json.dumps({
+        "telemetry_dry_run": "ok",
+        "telemetry_overhead": smoke["telemetry_overhead"],
+        "steps_per_sec_tracing_on": smoke["steps_per_sec_tracing_on"],
+        "steps_per_sec_tracing_off":
+            smoke["steps_per_sec_tracing_off"],
+        "merged_roles": smoke["merged_roles"],
+        "merged_spans": smoke["merged_spans"],
+        "aggregated_metric_records":
+            smoke["aggregated_metric_records"],
+    }))
+    return
   if "--serving" in args and "--dry-run" in args:
     # Tier-1 smoke of the serving bench path: tiny model, one small
     # bucket table, local backend, NO detail-file write (a CPU smoke
@@ -2389,7 +2597,7 @@ def main():
   axis_flags = {"--input", "--replay", "--replayfeed", "--longcontext",
                 "--podscale", "--moe", "--pipeline", "--verify",
                 "--serving", "--coldstart", "--mxu", "--mfu",
-                "--fleet", "--envs"}
+                "--fleet", "--envs", "--telemetry"}
   axis_only = (bool(args) and not run_paper and profile_dir is None
                and "--primary" not in args
                and all(a in axis_flags for a in args))
@@ -2496,6 +2704,10 @@ def main():
       section["speedup_vs_fleet_single_program"] = round(
           single["env_steps_per_sec"] / fleet_baseline, 1)
     detail["envs"] = section
+  if "--telemetry" in args:
+    # Writes artifacts/telemetry/fleet_trace.json.gz (the committed
+    # merged timeline) and enforces the <2% tracing-overhead gate.
+    detail["telemetry"] = bench_telemetry()
   if "--coldstart" in args:
     detail["coldstart"] = bench_coldstart()
   if "--mfu" in args:

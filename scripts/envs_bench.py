@@ -33,6 +33,9 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+# After the path bootstrap: the script must run standalone too.
+from tensor2robot_tpu.telemetry.records import read_records  # noqa: E402
+
 TRIALS = 5
 
 
@@ -215,8 +218,7 @@ def main() -> None:
     with tempfile.TemporaryDirectory() as tmp:
       train_anakin(learner=learner, model_dir=tmp, env=env, seed=0,
                    num_devices=num_devices, **kwargs)
-      return [json.loads(line) for line in
-              open(os.path.join(tmp, "metrics_train.jsonl"))][-1]
+      return read_records(os.path.join(tmp, "metrics_train.jsonl"))[-1]
 
   with tempfile.TemporaryDirectory() as tmp:
     if dry_run:
@@ -231,8 +233,7 @@ def main() -> None:
                     log_every_steps=32, save_checkpoints_steps=96)
     train_anakin(learner=learner, model_dir=tmp, env=env, seed=0,
                  **kwargs)
-    rows = [json.loads(line)
-            for line in open(os.path.join(tmp, "metrics_train.jsonl"))]
+    rows = read_records(os.path.join(tmp, "metrics_train.jsonl"))
   last = rows[-1]
   interleaved = {
       "num_envs": kwargs["num_envs"],

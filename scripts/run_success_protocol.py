@@ -45,6 +45,10 @@ import tempfile
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+
+# After the path bootstrap: the script must run standalone
+# (`python scripts/run_success_protocol.py ...`).
+from tensor2robot_tpu.telemetry.records import read_records  # noqa: E402
 ARTIFACTS = os.path.join(REPO, "artifacts", "success_protocol")
 
 # The one seed every stochastic input of the protocol derives from.
@@ -61,7 +65,7 @@ def _copy_jsonl(model_dir: str, tag: str, out_name: str) -> dict:
   dst = os.path.join(ARTIFACTS, out_name)
   os.makedirs(ARTIFACTS, exist_ok=True)
   shutil.copyfile(src, dst)
-  records = [json.loads(line) for line in open(src)]
+  records = read_records(src)
   return {"records": len(records), "last": records[-1]}
 
 
@@ -237,7 +241,7 @@ def run_qtopt_online(tmp: str) -> None:
     server.close()
 
   src = os.path.join(model_dir, "metrics_success_eval.jsonl")
-  records = [json.loads(line) for line in open(src)]
+  records = read_records(src)
   for r in records:
     r["phase"] = "offline" if r["step"] <= offline_steps else "online"
   offline_final = max(
@@ -337,8 +341,8 @@ def run_envs(tmp: str) -> None:
                              num_scenarios=512,
                              seed=PROTOCOL_SEED + 5,
                              cem_population=64, cem_iterations=3)
-  train_records = [json.loads(line) for line in
-                   open(os.path.join(model_dir, "metrics_train.jsonl"))]
+  train_records = read_records(
+      os.path.join(model_dir, "metrics_train.jsonl"))
   records = []
   for bucket, stats in sorted(sweep["per_bucket"].items()):
     records.append({"scenario_bucket": bucket,

@@ -50,6 +50,15 @@ echo "--- envs bench smoke (bench.py --envs --dry-run; 2-device pod leg) ---"
 env JAX_PLATFORMS=cpu python bench.py --envs --dry-run
 envs_rc=$?
 
+# The telemetry smoke is the ISSUE-11 trace-merge gate: a REAL (tiny)
+# 2-actor fleet runs with the telemetry plane on, every process's
+# trace merges into one timeline, and the smoke FAILS unless spans
+# from the learner, the host, and both actors are present; the
+# tracing-overhead A/B probe rides along.
+echo "--- telemetry smoke (bench.py --telemetry --dry-run; trace merge) ---"
+env JAX_PLATFORMS=cpu python bench.py --telemetry --dry-run
+telemetry_rc=$?
+
 if [ "$rc" -ne 0 ]; then exit "$rc"; fi
 if [ "$smoke_rc" -ne 0 ]; then exit "$smoke_rc"; fi
 if [ "$coldstart_rc" -ne 0 ]; then exit "$coldstart_rc"; fi
@@ -57,4 +66,5 @@ if [ "$replay_rc" -ne 0 ]; then exit "$replay_rc"; fi
 if [ "$input_rc" -ne 0 ]; then exit "$input_rc"; fi
 if [ "$mfu_rc" -ne 0 ]; then exit "$mfu_rc"; fi
 if [ "$fleet_rc" -ne 0 ]; then exit "$fleet_rc"; fi
-exit "$envs_rc"
+if [ "$envs_rc" -ne 0 ]; then exit "$envs_rc"; fi
+exit "$telemetry_rc"

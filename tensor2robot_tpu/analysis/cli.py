@@ -33,7 +33,10 @@ REPO_ROOT = os.path.dirname(_PACKAGE_DIR)
 # fleet added by ISSUE 8 — the orchestrator's process/thread
 # lifecycle lands with zero pragmas, baseline stays empty;
 # envs added by ISSUE 9 — pure functional code, so CON findings there
-# would mean the purity contract broke);
+# would mean the purity contract broke;
+# telemetry added by ISSUE 11 — the tracer/registry sit on RPC
+# handlers and train loops from many threads, so a blocking-under-lock
+# hazard there would stall the very paths it measures);
 # jax covers the whole package (traced code lives everywhere: models,
 # ops, parallel, research — and the envs family is scanned code by
 # construction: envs ARE traced functions).
@@ -45,6 +48,7 @@ _CONCURRENCY_PATHS = (
     "tensor2robot_tpu/startup",
     "tensor2robot_tpu/fleet",
     "tensor2robot_tpu/envs",
+    "tensor2robot_tpu/telemetry",
 )
 _GIN_PATHS = ("tensor2robot_tpu",)
 

@@ -39,6 +39,11 @@ WORKER_SAFE_MODULES = (
     "tensor2robot_tpu.fleet.actor",
     "tensor2robot_tpu.research.qtopt.actor",
     "tensor2robot_tpu.research.pose_env.grasp_bandit",
+    # ISSUE 11: the telemetry plane records in actor/worker processes
+    # (spans, metrics, flight dumps) — the whole package stays
+    # jax-free (the dynamic twin is tests/test_telemetry.py's
+    # subprocess import pin).
+    "tensor2robot_tpu.telemetry",
 )
 
 BANNED_IMPORTS = ("jax", "tensorflow")

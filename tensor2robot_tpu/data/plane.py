@@ -56,6 +56,7 @@ import numpy as np
 
 from tensor2robot_tpu import config as gin
 from tensor2robot_tpu.data.shm_ring import ShmRing, WireLayout
+from tensor2robot_tpu.telemetry import metrics as tmetrics
 
 log = logging.getLogger(__name__)
 
@@ -208,6 +209,7 @@ class HostDataPlane:
 
   def _latch(self, err: BaseException) -> BaseException:
     self._error = err
+    tmetrics.counter("data_plane.worker_failures").inc()
     return err
 
   def _check_workers(self) -> None:
@@ -278,6 +280,7 @@ class HostDataPlane:
         continue
       if tag == _BATCH:
         self.batches_out += 1
+        tmetrics.counter("data_plane.batches").inc()
         if self._copy:
           batch = {k: np.array(v)
                    for k, v in self._ring.views(payload).items()}

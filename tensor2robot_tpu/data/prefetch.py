@@ -22,6 +22,7 @@ import numpy as np
 
 from tensor2robot_tpu import config as gin
 from tensor2robot_tpu.specs import TensorSpecStruct
+from tensor2robot_tpu.telemetry import metrics as tmetrics
 
 
 @gin.configurable
@@ -365,6 +366,9 @@ class TimedIterator:
     fraction = min(max(self.wait_secs / max(interval_secs, 1e-9), 0.0),
                    1.0)
     self.wait_secs = 0.0
+    # Registry publication: the telemetry-plane twin of the train
+    # log's input_wait_fraction (one gauge set per log interval).
+    tmetrics.gauge("input.wait_fraction").set(fraction)
     return fraction
 
 

@@ -40,6 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tensor2robot_tpu import config as gin
+from tensor2robot_tpu import telemetry
 from tensor2robot_tpu.envs.core import (
     AutoResetEnv,
     BatchedEnv,
@@ -363,8 +364,12 @@ def train_anakin(
   spec = learner.transition_specification().to_flat_dict()
 
   os.makedirs(model_dir, exist_ok=True)
-  metric_logger = MetricLogger(model_dir)
+  # The anakin trainer's records carry its own envelope role without
+  # touching the process-global tracer identity.
+  metric_logger = MetricLogger(model_dir, role="anakin")
   hook_list = HookList(list(hooks))
+  from tensor2robot_tpu.startup.compile_cache import CompileWatch
+  CompileWatch.install_tap()
 
   mesh = None
   if shard_weight_update:
@@ -517,8 +522,12 @@ def train_anakin(
   last_saved = resume_step
   try:
     while step < max_train_steps:
-      carry, metrics = anakin_step(
-          carry, jax.random.fold_in(iter_key, step))
+      # Per-dispatch timing span: one collect-and-learn device program
+      # (rollout segment + ring insert + K Bellman steps).
+      with telemetry.span("anakin.dispatch", step=step, k=k,
+                          devices=d):
+        carry, metrics = anakin_step(
+            carry, jax.random.fold_in(iter_key, step))
       step += k
       steps_since_log += k
       hook_list.after_step(step, device0(metrics))
@@ -547,6 +556,7 @@ def train_anakin(
         # Zero BY CONSTRUCTION (acting params == training params in
         # one program) — logged so fleet-mode dashboards compare.
         scalars["param_refresh_lag_steps"] = 0.0
+        scalars.update(telemetry.registry().scalars("compile_cache."))
         metric_logger.write("train", step, scalars)
         t_last = time.time()
         steps_since_log = 0

@@ -29,12 +29,16 @@ from __future__ import annotations
 
 import logging
 import os
+import time
 from typing import Any, Dict, Optional
 
 import numpy as np
 
+from tensor2robot_tpu import telemetry
 from tensor2robot_tpu.fleet import proc
 from tensor2robot_tpu.fleet.rpc import RpcClient
+from tensor2robot_tpu.telemetry import flightrec
+from tensor2robot_tpu.telemetry import metrics as tmetrics
 
 log = logging.getLogger(__name__)
 
@@ -155,14 +159,36 @@ def _inject_crash(mode: str, sink: FleetReplaySession) -> None:
   raise RuntimeError("injected actor crash (FleetConfig.actor_crash_*)")
 
 
+def _push_telemetry(client: RpcClient, role: str) -> None:
+  """Ships this process's registry snapshot to the host (best-effort:
+  telemetry must never take an actor down)."""
+  try:
+    client.call("telemetry_push", {
+        "role": role,
+        "snapshot": tmetrics.registry().snapshot()})
+  except Exception:  # noqa: BLE001 — instrumentation only
+    log.warning("telemetry push failed", exc_info=True)
+
+
 def actor_main(config, actor_index: int, address, stop_event,
                heartbeat, incarnation: int = 0) -> None:
   """Child-process entry: connect → collect until told to stop."""
   proc.scrub_inherited_distributed_env()
   actor_id = f"actor-{actor_index}"
+  telemetry.configure(
+      actor_id, trace_dir=getattr(config, "telemetry_dir", "") or None,
+      actor_id=actor_id)
   client = RpcClient(tuple(address), authkey=config.authkey)
   try:
+    t_before = time.monotonic()
     hello = client.call("hello")
+    t_after = time.monotonic()
+    if "monotonic" in hello:
+      # The clock handshake: this actor's spans merge onto the host's
+      # monotonic timeline (telemetry.merge).
+      telemetry.get_tracer().set_clock_offset(
+          telemetry.clock_offset_from_handshake(
+              hello["monotonic"], t_before, t_after))
     policy = FleetPolicyClient(client, max_batch=hello["max_batch"])
     sink = FleetReplaySession(client, actor_id, policy)
     env = build_env(config, actor_index)
@@ -183,15 +209,45 @@ def actor_main(config, actor_index: int, address, stop_event,
         if (actor_index == config.crash_actor_index and incarnation == 0)
         else None)
     batches = 0
+    episodes = tmetrics.gauge("actor.episodes_collected")
+    dropped = tmetrics.gauge("actor.episodes_dropped")
+    # Snapshot pushes ride the acting connection, so they are (a) off
+    # with the plane (telemetry_dir="off" — the orchestrator never
+    # polls), and (b) rate-limited to the orchestrator's poll cadence:
+    # pushing faster than anyone reads is pure dead-write latency on
+    # the act/commit path.
+    push_period = (max(float(getattr(config, "telemetry_poll_secs",
+                                     0.0)), 1.0)
+                   if getattr(config, "telemetry_dir", "")
+                   and getattr(config, "telemetry_poll_secs", 0.0)
+                   else None)
+    t_last_push = 0.0
     while not stop_event.is_set():
-      actor.collect_once()
+      with telemetry.span("actor.collect_batch",
+                          batch=config.batch_episodes):
+        actor.collect_once()
+      # Mirror the actor's cumulative accounting into the registry
+      # (gauges: the actor object owns the true counters).
+      episodes.set(actor.episodes_collected)
+      dropped.set(actor.episodes_dropped)
       batches += 1
       proc.beat(heartbeat)
+      if (push_period is not None
+          and time.monotonic() - t_last_push >= push_period):
+        t_last_push = time.monotonic()
+        _push_telemetry(client, actor_id)
       if crash_after is not None and batches >= crash_after:
         _inject_crash(config.actor_crash_mode, sink)
     log.info("actor %s stopping cleanly: %d committed / %d dropped "
              "episodes, last policy version %s", actor_id,
              actor.episodes_collected, actor.episodes_dropped,
              actor.last_policy_version)
+  except BaseException as e:
+    # The crash-policy flight record: the orchestrator sees exit
+    # codes; THIS preserves what the actor was doing when it died.
+    if getattr(config, "flightrec_dir", ""):
+      flightrec.dump(config.flightrec_dir, f"{actor_id}: {e!r}")
+    raise
   finally:
+    telemetry.get_tracer().close()
     client.close()

@@ -43,14 +43,19 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
+from tensor2robot_tpu import telemetry
 from tensor2robot_tpu.fleet import proc
 from tensor2robot_tpu.fleet import rpc as rpc_lib
+from tensor2robot_tpu.telemetry import flightrec
+from tensor2robot_tpu.telemetry import metrics as tmetrics
 
 log = logging.getLogger(__name__)
 
 # Lag histogram bucket upper bounds, in learner steps (same labelling
-# scheme as the replay plane's staleness histogram).
-LAG_BUCKETS = (0, 1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 4096)
+# scheme as the replay plane's staleness histogram). ONE source of
+# truth with the telemetry registry's step-bucket family so the
+# authoritative snapshot and its registry twin can never desynchronize.
+LAG_BUCKETS = tuple(int(b) for b in tmetrics.DEFAULT_STEP_BOUNDS)
 
 
 class _LagStats:
@@ -62,6 +67,8 @@ class _LagStats:
     self._sum = 0
     self._max = 0
     self._n = 0
+    self._tm_lag = tmetrics.histogram(
+        "fleet.param_refresh_lag_steps", tmetrics.DEFAULT_STEP_BOUNDS)
 
   def record(self, lag: int, rows: int) -> None:
     lag = max(int(lag), 0)
@@ -71,6 +78,11 @@ class _LagStats:
       self._sum += lag * rows
       self._max = max(self._max, lag)
       self._n += rows
+    # Twin publication into the process registry (same step-bucket
+    # family, same ROW weighting as the accumulator above), so the
+    # telemetry RPC serves lag without touching this class and the
+    # flight recorder captures it.
+    self._tm_lag.observe(lag, n=rows)
 
   def snapshot(self) -> Dict[str, Any]:
     with self._lock:
@@ -98,6 +110,11 @@ class _HostState:
     from tensor2robot_tpu.serving.cem_policy import CEMPolicyServer
 
     self._config = config
+    # The host's telemetry identity: spans from the RPC layer and the
+    # serving/replay planes flush to trace_host.jsonl; its clock is
+    # the REFERENCE clock every handshaking client offsets against.
+    telemetry.configure(
+        "host", trace_dir=getattr(config, "telemetry_dir", "") or None)
     self._learner = _build_learner(config)
     state0 = self._learner.create_state(
         jax.random.PRNGKey(config.seed), batch_size=2)
@@ -119,6 +136,11 @@ class _HostState:
     self._sampler_cls = ReplayBatchSampler
     self._samplers: Dict[int, Any] = {}
     self._sessions: Dict[str, Any] = {}
+    # Per-role registry snapshots pushed by actors/learner over the
+    # `telemetry_push` RPC; the orchestrator's `telemetry` poll
+    # returns them next to the host's own registry — one aggregated
+    # fleet-wide view from one call.
+    self._pushed_telemetry: Dict[str, Any] = {}
     self._lock = threading.Lock()
     self.lag = _LagStats()
     self.publishes = 0
@@ -225,6 +247,7 @@ class _HostState:
         self.publishes += 1
         if self._publish_t0 is None:
           self._publish_t0 = time.monotonic()
+      tmetrics.counter("fleet.param_publishes").inc()
       return self.policy_server.params_version
     if method == "metrics_scalars":
       out = self.store.metrics_scalars()
@@ -239,10 +262,36 @@ class _HostState:
       return self.metrics()
     if method == "hello":
       engine = self.policy_server.engine
+      # `monotonic` is the telemetry clock handshake: the client reads
+      # its own clock around the call and derives its offset to this
+      # host's CLOCK_MONOTONIC (telemetry.clock_offset_from_handshake)
+      # — how the merge tool puts every process on one timeline.
       return {"max_batch": engine.max_batch,
               "capacity": self.store.capacity,
               "params_version": engine.params_version,
-              "params_learner_step": engine.params_learner_step}
+              "params_learner_step": engine.params_learner_step,
+              "monotonic": time.monotonic()}
+    if method == "telemetry":
+      # The fleet-wide aggregated view (one poll): the host's own
+      # registry — replay/serving/lag live HERE, at the choke point —
+      # plus whatever snapshots the other roles pushed.
+      with self._lock:
+        pushed = dict(self._pushed_telemetry)
+      return {"host": tmetrics.registry().snapshot(),
+              "pushed": pushed,
+              "monotonic": time.monotonic()}
+    if method == "telemetry_push":
+      with self._lock:
+        self._pushed_telemetry[str(payload["role"])] = {
+            "snapshot": payload["snapshot"],
+            "wall": time.time(),
+        }
+      return True
+    if method == "flight_record":
+      # The orchestrator's latched-error hook: a still-live host dumps
+      # its span ring + registry before teardown.
+      return flightrec.dump(payload["out_dir"],
+                            payload.get("reason", "requested"))
     if method == "shutdown":
       self.shutdown_requested.set()
       return True
@@ -335,8 +384,16 @@ def host_main(config, ready_conn, stop_event, heartbeat) -> None:
   shutdown barrier). The RPC `shutdown` method is the other exit.
   """
   proc.scrub_inherited_distributed_env()
-  state = _HostState(config)
-  server = rpc_lib.RpcServer(state.handle, authkey=config.authkey)
+  try:
+    state = _HostState(config)
+    server = rpc_lib.RpcServer(state.handle, authkey=config.authkey)
+  except BaseException as e:
+    # A host that dies building (bad config, compile failure) leaves
+    # its last moments in the flight recorder before the orchestrator
+    # sees the exit code.
+    if getattr(config, "flightrec_dir", ""):
+      flightrec.dump(config.flightrec_dir, f"host launch failed: {e!r}")
+    raise
   try:
     ready_conn.send({"address": server.address})
     ready_conn.close()
@@ -346,3 +403,4 @@ def host_main(config, ready_conn, stop_event, heartbeat) -> None:
   finally:
     server.close()
     state.close()
+    telemetry.get_tracer().close()  # flush the host's trace tail

@@ -27,9 +27,12 @@ import logging
 import time
 from typing import Any, Dict, Iterator, Optional
 
+from tensor2robot_tpu import telemetry
 from tensor2robot_tpu.fleet import proc
 from tensor2robot_tpu.fleet.rpc import RpcClient
 from tensor2robot_tpu.hooks.hook import Hook
+from tensor2robot_tpu.telemetry import flightrec
+from tensor2robot_tpu.telemetry import metrics as tmetrics
 
 log = logging.getLogger(__name__)
 
@@ -88,8 +91,9 @@ class ParamPublishHook(Hook):
 
   drives_online_collection = True
 
-  def __init__(self, control: RpcClient):
+  def __init__(self, control: RpcClient, telemetry_push: bool = True):
     self._control = control
+    self._telemetry_push = telemetry_push
     self.publishes = 0
 
   def after_checkpoint(self, step: int, state, model_dir: str) -> None:
@@ -98,11 +102,24 @@ class ParamPublishHook(Hook):
     acting = (state.replace(opt_state=None)
               if hasattr(state, "replace")
               and hasattr(state, "opt_state") else state)
-    self._control.call("publish", {
-        "step": int(step),
-        "state": jax.device_get(acting),
-    })
+    with telemetry.span("learner.publish_params", step=int(step)):
+      self._control.call("publish", {
+          "step": int(step),
+          "state": jax.device_get(acting),
+      })
     self.publishes += 1
+    tmetrics.counter("learner.param_publishes").inc()
+    # Publish cadence doubles as the learner's telemetry-push cadence
+    # (the control client is owned by this thread — RpcClient is
+    # single-owner). Skipped when the plane is off.
+    if not self._telemetry_push:
+      return
+    try:
+      self._control.call("telemetry_push", {
+          "role": "learner",
+          "snapshot": tmetrics.registry().snapshot()})
+    except Exception:  # noqa: BLE001 — instrumentation only
+      log.warning("learner telemetry push failed", exc_info=True)
 
 
 class _HeartbeatHook(Hook):
@@ -132,6 +149,9 @@ def learner_main(config, model_dir: str, address, heartbeat,
                  coordinator_address: Optional[str] = None) -> None:
   """Child-process entry: connect → train_qtopt → clean exit."""
   proc.scrub_inherited_distributed_env()
+  telemetry.configure(
+      "learner",
+      trace_dir=getattr(config, "telemetry_dir", "") or None)
   if config.distributed_learner and coordinator_address:
     # The orchestrator picked this address with
     # ephemeral_coordinator_address(); adopt it before any jax use so
@@ -149,9 +169,18 @@ def learner_main(config, model_dir: str, address, heartbeat,
     from tensor2robot_tpu.fleet.host import _build_learner
     from tensor2robot_tpu.research.qtopt.train_qtopt import train_qtopt
 
+    t_before = time.monotonic()
     hello = control.call("hello")
+    t_after = time.monotonic()
+    if "monotonic" in hello:
+      telemetry.get_tracer().set_clock_offset(
+          telemetry.clock_offset_from_handshake(
+              hello["monotonic"], t_before, t_after))
     replay = RemoteReplay(control, stream, capacity=hello["capacity"])
-    hooks = [ParamPublishHook(control), _HeartbeatHook(heartbeat)]
+    hooks = [ParamPublishHook(
+        control,
+        telemetry_push=bool(getattr(config, "telemetry_dir", ""))),
+        _HeartbeatHook(heartbeat)]
     if config.learner_crash_after_steps:
       hooks.append(_CrashAfterHook(config.learner_crash_after_steps))
     train_qtopt(
@@ -165,6 +194,14 @@ def learner_main(config, model_dir: str, address, heartbeat,
         log_every_steps=config.log_every_steps,
         hooks=hooks,
         seed=config.seed)
+  except BaseException as e:
+    # The latched-error flight record: the learner's last spans +
+    # metrics survive its death (the crash-policy contract pinned by
+    # tests/test_telemetry.py).
+    if getattr(config, "flightrec_dir", ""):
+      flightrec.dump(config.flightrec_dir, f"learner: {e!r}")
+    raise
   finally:
+    telemetry.get_tracer().close()
     stream.close()
     control.close()

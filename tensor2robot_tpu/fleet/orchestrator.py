@@ -40,6 +40,7 @@ Lifecycle contract (docs/FLEET.md):
 from __future__ import annotations
 
 import dataclasses
+import json
 import logging
 import multiprocessing as mp
 import os
@@ -54,6 +55,10 @@ from tensor2robot_tpu.fleet import actor as actor_lib
 from tensor2robot_tpu.fleet import host as host_lib
 from tensor2robot_tpu.fleet import learner as learner_lib
 from tensor2robot_tpu.fleet.rpc import RpcClient
+from tensor2robot_tpu.telemetry import core as tcore
+from tensor2robot_tpu.telemetry import flightrec
+from tensor2robot_tpu.telemetry import metrics as tmetrics
+from tensor2robot_tpu.telemetry import records as trecords
 
 log = logging.getLogger(__name__)
 
@@ -113,6 +118,12 @@ class FleetConfig:
   distributed_learner: bool = False
   seed: int = 0
   authkey: bytes = b""  # per-fleet key generated at Fleet construction
+  # Telemetry plane (docs/OBSERVABILITY.md). Empty = derived from the
+  # fleet's model_dir at launch (<model_dir>/telemetry, /flightrec);
+  # telemetry_dir="off" disables cross-process tracing entirely.
+  telemetry_dir: str = ""
+  flightrec_dir: str = ""
+  telemetry_poll_secs: float = 10.0  # 0 disables the aggregated poll
   # Fault injection (tests / bench failure-path rehearsal).
   actor_crash_after_episodes: Optional[int] = None
   actor_crash_mode: str = "raise"
@@ -166,6 +177,9 @@ class Fleet:
   def __init__(self, config: FleetConfig, model_dir: str,
                gin_configs: Sequence[str] = ()):
     self.config = config
+    # The per-run resolved copy (telemetry/flight-record dirs filled
+    # in) is built at launch(); until then fall back to the caller's.
+    self._run_config = config
     self.model_dir = model_dir
     self.gin_configs = tuple(gin_configs)
     self._ctx = mp.get_context("spawn")
@@ -186,6 +200,9 @@ class Fleet:
     self._launched = False
     self._closed = False
     self._t_launched: Optional[float] = None
+    self._tracer: Optional[tcore.Tracer] = None
+    self._telemetry_file: Optional[Any] = None
+    self._t_last_poll = 0.0
 
   # ---- launch ----
 
@@ -214,8 +231,8 @@ class Fleet:
     heartbeat = self._heartbeat(name)
     process = self._ctx.Process(
         target=actor_lib.actor_main,
-        args=(self.config, index, self._address, self._stop, heartbeat,
-              incarnation),
+        args=(self._run_config, index, self._address, self._stop,
+              heartbeat, incarnation),
         name=name, daemon=True)
     process.start()
     self._actors[index] = process
@@ -225,7 +242,28 @@ class Fleet:
     if self._launched:
       return
     self._run_launch_gate()
-    config = self.config
+    # Resolve the telemetry plane BEFORE spawn into a per-RUN copy:
+    # the copy ships (via pickle) to every child, so this is the one
+    # place the trace/flight-record directories are decided — and the
+    # caller's FleetConfig is never mutated (a reused config must not
+    # inherit run 1's dirs, nor lose an explicit "off" opt-out).
+    telemetry_dir = self.config.telemetry_dir
+    if telemetry_dir == "off":
+      telemetry_dir = ""  # tracing off; flight dumps keep working
+    elif not telemetry_dir:
+      telemetry_dir = os.path.join(self.model_dir, "telemetry")
+    config = dataclasses.replace(
+        self.config,
+        telemetry_dir=telemetry_dir,
+        flightrec_dir=(self.config.flightrec_dir
+                       or flightrec.flightrec_dir(self.model_dir)))
+    self._run_config = config
+    if config.telemetry_dir:
+      # The orchestrator's own timeline: a PRIVATE tracer (never the
+      # process-global one — the supervising process may be a trainer
+      # or a test with its own telemetry identity).
+      self._tracer = tcore.Tracer().configure(
+          "orchestrator", trace_dir=config.telemetry_dir)
     parent_conn, child_conn = self._ctx.Pipe()
     self._host = self._ctx.Process(
         target=host_lib.host_main,
@@ -276,6 +314,9 @@ class Fleet:
     self._learner.start()
     self._launched = True
     self._t_launched = time.monotonic()
+    if self._tracer is not None:
+      self._tracer.event("orchestrator.launched",
+                         actors=config.num_actors)
 
   # ---- supervision ----
 
@@ -296,6 +337,96 @@ class Fleet:
         raise FleetError(
             f"{name} heartbeat stale for {now - last:.0f}s "
             f"(> {timeout:.0f}s): process hung")
+
+  def _fresh_control(self) -> Optional[RpcClient]:
+    """A new control-channel client (a timed-out call poisons the old
+    one — rpc.py contract); None when the host is unreachable."""
+    if self._address is None:
+      return None
+    try:
+      return RpcClient(self._address,
+                       authkey=self._run_config.authkey,
+                       connect_timeout_secs=10.0)
+    except Exception:  # noqa: BLE001
+      log.warning("control-channel reconnect failed", exc_info=True)
+      return None
+
+  def _poll_telemetry(self, force: bool = False) -> None:
+    """One aggregated fleet-wide metrics read at the poll cadence:
+    the host's registry (replay/serving/lag live at that choke point)
+    plus every snapshot the other roles pushed, flattened per-role and
+    appended to `<telemetry_dir>/fleet_metrics.jsonl` as one envelope
+    record. `force` bypasses the cadence gate (the end-of-run view
+    must land even when the learner finishes mid-interval)."""
+    cadence = self._run_config.telemetry_poll_secs
+    if (not cadence or self._control is None
+        or not self._run_config.telemetry_dir):
+      return
+    now = time.monotonic()
+    if not force and now - self._t_last_poll < cadence:
+      return
+    self._t_last_poll = now
+    try:
+      view = self._control.call("telemetry", timeout_secs=30.0)
+    except Exception:  # noqa: BLE001 — instrumentation only
+      # A timed-out call POISONS the client (rpc.py contract: the
+      # late reply may still arrive and would be read as the answer
+      # to the next control call — e.g. the final `metrics`).
+      # Instrumentation must not corrupt the control channel: drop
+      # the connection and open a fresh one; on failure, leave the
+      # orchestrator without a control client (shutdown handles None).
+      log.warning("fleet telemetry poll failed; reconnecting the "
+                  "control channel", exc_info=True)
+      self._control.close()
+      self._control = self._fresh_control()
+      return
+    payload = tmetrics.scalars_from_snapshot(view.get("host") or {})
+    for role, pushed in (view.get("pushed") or {}).items():
+      payload.update(tmetrics.scalars_from_snapshot(
+          pushed.get("snapshot") or {}, prefix=f"{role}/"))
+    record = trecords.make_record(
+        int(payload.get("replay.learner_step", 0)), payload,
+        role="orchestrator")
+    if self._telemetry_file is None:
+      self._telemetry_file = open(
+          os.path.join(self._run_config.telemetry_dir,
+                       "fleet_metrics.jsonl"), "a")
+    self._telemetry_file.write(json.dumps(record) + "\n")
+    self._telemetry_file.flush()
+    if self._tracer is not None:
+      self._tracer.event("orchestrator.telemetry_poll",
+                         metrics=len(payload))
+
+  def _flight_record(self, error: BaseException) -> None:
+    """The latched-error / hang-detection flight-recorder trigger:
+    dump the orchestrator's view (heartbeat ages name a HUNG process —
+    one that cannot dump itself) and ask a still-live host to dump its
+    own ring; learner/actor dumps happen in their processes' except
+    paths."""
+    if not self._run_config.flightrec_dir:
+      return
+    now = time.monotonic()
+    ages = {
+        name: round(now - max(value.value,
+                              self._spawned_at.get(name, 0.0)), 3)
+        for name, value in self._heartbeats.items()}
+    flightrec.dump(
+        self._run_config.flightrec_dir, f"fleet latched: {error!r}",
+        extra={"heartbeat_ages_secs": ages,
+               "actor_restarts": dict(self._restarts)},
+        role="orchestrator")
+    if (self._control is not None and self._host is not None
+        and self._host.is_alive()):
+      try:
+        self._control.call("flight_record", {
+            "out_dir": self._run_config.flightrec_dir,
+            "reason": f"fleet latched: {error!r}"}, timeout_secs=15.0)
+      except Exception:  # noqa: BLE001 — forensics must not mask
+        log.warning("host flight-record request failed", exc_info=True)
+        # Poisoned on timeout (rpc.py contract) and we are aborting:
+        # drop it rather than let shutdown read a stale reply.
+        self._control.close()
+        self._control = None
 
   def _supervise_once(self) -> bool:
     """One poll; returns True when the learner finished cleanly."""
@@ -336,7 +467,10 @@ class Fleet:
     try:
       while True:
         if self._supervise_once():
+          # Final aggregated view of the run, cadence bypassed.
+          self._poll_telemetry(force=True)
           return
+        self._poll_telemetry()
         if time.monotonic() > deadline:
           raise FleetError(
               f"fleet exceeded run_timeout_secs="
@@ -344,6 +478,7 @@ class Fleet:
         time.sleep(0.05)
     except BaseException as e:
       self._latch(e)
+      self._flight_record(e)
       self._abort()
       raise self._error from None
 
@@ -386,12 +521,18 @@ class Fleet:
       self._join_or_kill(process, timeout_secs / 2,
                          f"actor {index}")
     metrics = None
-    if (collect_metrics and self._control is not None
-        and self._host is not None and self._host.is_alive()):
-      try:
-        metrics = self._control.call("metrics", timeout_secs=30.0)
-      except Exception:
-        log.warning("final metrics read failed", exc_info=True)
+    if (collect_metrics and self._host is not None
+        and self._host.is_alive()):
+      # The control client may have been dropped by a failed telemetry
+      # poll (its poisoning contract); a telemetry hiccup must not
+      # cost a clean run its final metrics — reconnect for the read.
+      if self._control is None:
+        self._control = self._fresh_control()
+      if self._control is not None:
+        try:
+          metrics = self._control.call("metrics", timeout_secs=30.0)
+        except Exception:
+          log.warning("final metrics read failed", exc_info=True)
     self._host_stop.set()
     if self._control is not None:
       if self._host is not None and self._host.is_alive():
@@ -406,6 +547,11 @@ class Fleet:
       self._join_or_kill(self._learner, timeout_secs / 2, "learner")
     if self._host is not None:
       self._join_or_kill(self._host, timeout_secs / 2, "host")
+    if self._telemetry_file is not None:
+      self._telemetry_file.close()
+      self._telemetry_file = None
+    if self._tracer is not None:
+      self._tracer.close()
     leaked = [p.name for p in self._all_processes() if p.is_alive()]
     if leaked:
       raise FleetError(f"shutdown leaked processes: {leaked}")

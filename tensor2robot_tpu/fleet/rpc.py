@@ -39,6 +39,8 @@ import traceback
 from multiprocessing.connection import Client, Listener
 from typing import Any, Callable, List, Optional, Tuple
 
+from tensor2robot_tpu import telemetry
+
 log = logging.getLogger(__name__)
 
 # The shared secret for connection auth. Loopback-only transport; the
@@ -104,7 +106,11 @@ class RpcServer:
         except (EOFError, OSError):
           break
         try:
-          result = self._handler(method, payload, ctx)
+          # Every RPC method gets a server-side span for free: the
+          # merged timeline shows act/commit/sample handler time per
+          # connection thread (no-op until telemetry is configured).
+          with telemetry.span(f"rpc.{method}"):
+            result = self._handler(method, payload, ctx)
           reply = ("ok", result)
         except BaseException:  # serialized back, connection stays up
           reply = ("err", traceback.format_exc())
@@ -189,11 +195,17 @@ class RpcClient:
     considered poisoned (an in-flight reply may still arrive).
     """
     try:
-      self._conn.send((method, payload))
-      if timeout_secs is not None and not self._conn.poll(timeout_secs):
-        raise TimeoutError(
-            f"fleet rpc: no reply to {method!r} in {timeout_secs:.0f}s")
-      status, value = self._conn.recv()
+      # Client-side span: the caller's view of the same RPC (queueing
+      # + transport + handler), so actor-vs-host wait decomposes in
+      # the merged timeline.
+      with telemetry.span(f"rpc_call.{method}"):
+        self._conn.send((method, payload))
+        if timeout_secs is not None and not self._conn.poll(
+            timeout_secs):
+          raise TimeoutError(
+              f"fleet rpc: no reply to {method!r} in "
+              f"{timeout_secs:.0f}s")
+        status, value = self._conn.recv()
     except (EOFError, OSError) as e:
       raise ConnectionError(
           f"fleet rpc: server dropped during {method!r}") from e

@@ -32,6 +32,7 @@ import numpy as np
 from tensor2robot_tpu import config as gin
 from tensor2robot_tpu.replay.store import ReplayStore
 from tensor2robot_tpu.specs import TensorSpecStruct
+from tensor2robot_tpu.telemetry import metrics as tmetrics
 
 # Fixed bucket EDGES (upper bounds, in learner steps) so histograms are
 # comparable across runs and JSON-stable; the last bucket is open.
@@ -63,6 +64,8 @@ class ReplayBatchSampler:
     # tracks the live distribution on long runs.
     self._recent_means = np.zeros(65536, np.float64)
     self._recent_count = 0
+    self._tm_staleness = tmetrics.histogram(
+        "replay.staleness_steps", tmetrics.DEFAULT_STEP_BOUNDS)
 
   @property
   def batch_size(self) -> int:
@@ -93,6 +96,9 @@ class ReplayBatchSampler:
       self._recent_count += 1
       if self._record_schedule:
         self._digest.update(row_ids.tobytes())
+    # Registry publication: per-batch mean age into the step-bucket
+    # histogram (the telemetry-plane view of the same distribution).
+    self._tm_staleness.observe(float(ages.mean()))
     return batch
 
   def __iter__(self) -> Iterator[TensorSpecStruct]:

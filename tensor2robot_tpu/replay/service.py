@@ -42,6 +42,7 @@ from tensor2robot_tpu.replay.store import (
     _record_event,
     to_flat_arrays,
 )
+from tensor2robot_tpu.telemetry import metrics as tmetrics
 
 log = logging.getLogger(__name__)
 
@@ -167,6 +168,9 @@ class ReplayWriteService:
     self.dropped_transitions = 0
     self.aborted_episodes = 0
     self.restarts = 0
+    self._tm_drops = tmetrics.counter("replay.dropped_transitions")
+    self._tm_aborts = tmetrics.counter("replay.aborted_episodes")
+    self._tm_queue_depth = tmetrics.gauge("replay.ingest_queue_depth")
     self._writer = threading.Thread(
         target=self._drain, name="replay-writer", daemon=True)
     self._writer.start()
@@ -219,10 +223,12 @@ class ReplayWriteService:
       with self._lock:
         self.dropped_batches += 1
         self.dropped_transitions += n
+      self._tm_drops.inc(n)
       _record_event("/t2r/replay/drop")
       return False
     with self._lock:
       self.enqueued_batches += 1
+    self._tm_queue_depth.set(self._queue.qsize())
     return True
 
   def _put_blocking(self, item: _Enqueued) -> None:
@@ -255,6 +261,7 @@ class ReplayWriteService:
   def _count_abort(self, actor_id: str) -> None:
     with self._lock:
       self.aborted_episodes += 1
+    self._tm_aborts.inc()
     _record_event("/t2r/replay/abort")
 
   # ---- writer thread ----

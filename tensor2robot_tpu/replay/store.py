@@ -47,6 +47,7 @@ import numpy as np
 from tensor2robot_tpu import config as gin
 from tensor2robot_tpu import specs as specs_lib
 from tensor2robot_tpu.specs import TensorSpecStruct
+from tensor2robot_tpu.telemetry import metrics as tmetrics
 from tensor2robot_tpu.utils import native
 
 SAMPLING_MODES = ("uniform", "fifo", "prioritized")
@@ -156,6 +157,14 @@ class ReplayStore:
     self.spilled_total = 0
     self._created = time.monotonic()
     self._last_snapshot = (time.monotonic(), 0, 0)
+    # Telemetry handles cached once: the add/sample hot paths call
+    # .inc()/.set() directly instead of re-resolving names through
+    # the registry lock per call.
+    self._tm_adds = tmetrics.counter("replay.adds")
+    self._tm_samples = tmetrics.counter("replay.samples")
+    self._tm_evictions = tmetrics.counter("replay.evictions")
+    self._tm_fill = tmetrics.gauge("replay.fill")
+    self._tm_learner_step = tmetrics.gauge("replay.learner_step")
 
   # ---- shape / introspection ----
 
@@ -192,6 +201,7 @@ class ReplayStore:
     assignment — safe to call every loop iteration from the trainer
     while actor threads add concurrently)."""
     self._learner_step = int(step)
+    self._tm_learner_step.set(self._learner_step)
 
   @property
   def learner_step(self) -> int:
@@ -267,7 +277,12 @@ class ReplayStore:
       self.adds_total += n
       self.add_calls += 1
       self.evictions_total += evicted
+    # Registry publication (telemetry plane): the same counters the
+    # snapshot reports, visible process-wide without a store handle.
+    self._tm_adds.inc(n)
+    self._tm_fill.set(len(self) / max(self._capacity, 1))
     if evicted:
+      self._tm_evictions.inc(evicted)
       _record_event("/t2r/replay/evict")
     return n
 
@@ -384,6 +399,7 @@ class ReplayStore:
     with self._stats_lock:
       self.samples_total += batch_size
       self.sample_calls += 1
+    self._tm_samples.inc(batch_size)
     np.maximum(ages, 0, out=ages)  # adds race the step tag by design
     return TensorSpecStruct.from_flat_dict(out), ages, row_ids
 

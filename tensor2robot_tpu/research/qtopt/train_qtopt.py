@@ -18,6 +18,7 @@ import jax
 import numpy as np
 
 from tensor2robot_tpu import config as gin
+from tensor2robot_tpu import telemetry
 from tensor2robot_tpu.data import prefetch as prefetch_lib
 from tensor2robot_tpu.hooks import Hook, HookList
 from tensor2robot_tpu.parallel import mesh as mesh_lib
@@ -109,6 +110,11 @@ def train_qtopt(
   os.makedirs(model_dir, exist_ok=True)
   metric_logger = MetricLogger(model_dir)
   hook_list = HookList(list(hooks))
+  # Compile-cache traffic → telemetry registry (the CompileWatch tap):
+  # a warm-path recompile lands in this loop's log, not only under
+  # bench --coldstart.
+  from tensor2robot_tpu.startup.compile_cache import CompileWatch
+  CompileWatch.install_tap()
 
   if replay_buffer is None:
     replay_buffer = ReplayBuffer(learner.transition_specification())
@@ -221,14 +227,15 @@ def train_qtopt(
     for transitions in prefetch_iter:
       if step >= max_train_steps:
         break
-      if k == 1:
-        state, metrics = train_step(
-            state, transitions, jax.random.fold_in(step_rng, step))
-      else:
-        # Same per-step PRNG stream as K=1: the scan body folds
-        # step_rng by ABSOLUTE step (step0 + i).
-        state, metrics = train_step(state, transitions, step_rng,
-                                    np.int32(step))
+      with telemetry.span("qtopt.dispatch", step=step, k=k):
+        if k == 1:
+          state, metrics = train_step(
+              state, transitions, jax.random.fold_in(step_rng, step))
+        else:
+          # Same per-step PRNG stream as K=1: the scan body folds
+          # step_rng by ABSOLUTE step (step0 + i).
+          state, metrics = train_step(state, transitions, step_rng,
+                                      np.int32(step))
       step += k
       steps_since_log += k
       if tag_step is not None:
@@ -245,6 +252,11 @@ def train_qtopt(
         replay_metrics = getattr(replay_buffer, "metrics_scalars", None)
         if replay_metrics is not None:
           scalars.update(replay_metrics())
+        # Compile-cache counters from the telemetry registry: a miss
+        # delta after the first interval is a warm-path recompile.
+        scalars.update(telemetry.registry().scalars("compile_cache."))
+        telemetry.registry().gauge("train.grad_steps_per_sec").set(
+            scalars["grad_steps_per_sec"])
         metric_logger.write("train", step, scalars)
         t_last = time.time()
         steps_since_log = 0

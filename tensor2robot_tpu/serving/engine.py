@@ -32,7 +32,9 @@ from typing import Any, Callable, Dict, NamedTuple, Optional
 import jax
 import numpy as np
 
+from tensor2robot_tpu import telemetry
 from tensor2robot_tpu.serving import bucketing
+from tensor2robot_tpu.telemetry import metrics as tmetrics
 
 
 class _Published(NamedTuple):
@@ -124,6 +126,11 @@ class BucketedServingEngine:
     self.dispatch_count = 0
     self.dispatches_per_bucket: Dict[int, int] = {}
     self.swap_count = 0
+    # Telemetry handles cached per engine (per-bucket lazily): the
+    # hot path calls .observe()/.inc() without a registry lookup.
+    self._tm_dispatches = tmetrics.counter("serving.dispatches")
+    self._tm_swaps = tmetrics.counter("serving.swaps")
+    self._tm_bucket_ms: Dict[int, Any] = {}
 
   @property
   def bucket_sizes(self):
@@ -272,6 +279,10 @@ class BucketedServingEngine:
                         else int(learner_step)))
       self._state = placed
       self.swap_count += 1
+    telemetry.event("serving.swap_state",
+                    version=self._published.version,
+                    learner_step=self._published.learner_step)
+    self._tm_swaps.inc()
 
   # ---- the hot path ----
 
@@ -288,13 +299,23 @@ class BucketedServingEngine:
     padded = bucketing.pad_batch(features, bucket)
     # One atomic read: old or new publication, never mixed.
     state = self._published.state
-    if self._takes_rng:
-      outputs = self._compiled[bucket](state, padded, rng)
-    else:
-      outputs = self._compiled[bucket](state, padded)
+    t0 = time.perf_counter()
+    with telemetry.span("serving.dispatch", bucket=bucket, rows=n):
+      if self._takes_rng:
+        outputs = self._compiled[bucket](state, padded, rng)
+      else:
+        outputs = self._compiled[bucket](state, padded)
+      outputs = jax.tree_util.tree_map(
+          lambda a: np.asarray(jax.device_get(a)), outputs)
+    # Registry publication: per-bucket latency (the serving p50/p95
+    # the telemetry RPC serves) next to the existing counters.
+    hist = self._tm_bucket_ms.get(bucket)
+    if hist is None:
+      hist = self._tm_bucket_ms[bucket] = tmetrics.histogram(
+          f"serving.bucket_{bucket}_ms")
+    hist.observe((time.perf_counter() - t0) * 1e3)
     self.dispatch_count += 1
     self.dispatches_per_bucket[bucket] = (
         self.dispatches_per_bucket.get(bucket, 0) + 1)
-    outputs = jax.tree_util.tree_map(
-        lambda a: np.asarray(jax.device_get(a)), outputs)
+    self._tm_dispatches.inc()
     return bucketing.unpad_batch(outputs, n)

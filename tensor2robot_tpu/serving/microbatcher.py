@@ -28,6 +28,9 @@ from typing import Any, Dict, List, Optional
 import jax
 import numpy as np
 
+from tensor2robot_tpu import telemetry
+from tensor2robot_tpu.telemetry import metrics as tmetrics
+
 
 class _Request:
 
@@ -66,6 +69,11 @@ class MicroBatcher:
     self.dispatches = 0
     self.requests = 0
     self.batch_sizes: List[int] = []
+    self._tm_queue_depth = tmetrics.gauge(
+        "serving.microbatch_queue_depth")
+    self._tm_rows = tmetrics.histogram(
+        "serving.microbatch_rows",
+        bounds=(1, 2, 4, 8, 16, 32, 64, 128, 256))
     self._thread = threading.Thread(target=self._run, daemon=True)
     self._thread.start()
 
@@ -133,18 +141,26 @@ class MicroBatcher:
 
   def _dispatch(self, batch: List[_Request]) -> None:
     try:
+      rows = sum(r.n for r in batch)
+      # Registry publication: queue depth at dispatch time (requests
+      # still waiting behind this batch) + coalesced batch size — the
+      # micro-batcher's two load signals.
+      self._tm_queue_depth.set(self._queue.qsize())
+      self._tm_rows.observe(rows)
       features = jax.tree_util.tree_map(
           lambda *leaves: np.concatenate(
               [np.asarray(a) for a in leaves], axis=0),
           *[r.features for r in batch])
-      if self._rng is not None:
-        key = jax.random.fold_in(self._rng, self._dispatch_index)
-        outputs = self._engine.predict(features, rng=key)
-      else:
-        outputs = self._engine.predict(features)
+      with telemetry.span("serving.microbatch_dispatch",
+                          requests=len(batch), rows=rows):
+        if self._rng is not None:
+          key = jax.random.fold_in(self._rng, self._dispatch_index)
+          outputs = self._engine.predict(features, rng=key)
+        else:
+          outputs = self._engine.predict(features)
       self._dispatch_index += 1
       self.dispatches += 1
-      self.batch_sizes.append(sum(r.n for r in batch))
+      self.batch_sizes.append(rows)
       offset = 0
       for request in batch:
         lo, hi = offset, offset + request.n

@@ -27,6 +27,7 @@ from typing import Optional
 import jax
 
 from tensor2robot_tpu import config as gin
+from tensor2robot_tpu.telemetry import metrics as tmetrics
 
 log = logging.getLogger(__name__)
 
@@ -83,6 +84,9 @@ def configure_compilation_cache(
   Returns the resolved cache dir (None when disabled).
   """
   global _configured, _configured_dir
+  # Every entry point that wires the cache also gets the registry tap
+  # (cache dir or not): compile traffic is telemetry either way.
+  CompileWatch.install_tap()
   if not cache_dir:
     # The env var is a DEFAULT, not an override: once any caller has
     # configured a cache explicitly (a bench probe's throwaway dir, a
@@ -214,13 +218,33 @@ class CompileWatch:
         return
       import jax.monitoring as monitoring
 
+      # Registry twin counters: once the listeners exist, EVERY cache
+      # event lands in the telemetry registry whether or not a watch
+      # is active — this is what closes the CompileWatch gap (ISSUE
+      # 11): warm-path recompiles surface in ordinary training logs
+      # (`compile_cache.misses` in metrics_<tag>.jsonl), not only
+      # under `bench.py --coldstart`. Names resolve PER EVENT (not
+      # captured handles): a registry reset (test isolation) must not
+      # orphan these counters for the rest of the process — compiles
+      # are rare, the lookup is nothing.
+      _event_names = {
+          _CACHE_HIT_EVENT: "compile_cache.hits",
+          _CACHE_MISS_EVENT: "compile_cache.misses",
+          _CACHE_REQUEST_EVENT: "compile_cache.requests",
+      }
+
       def on_event(event: str, **kwargs):
+        name = _event_names.get(event)
+        if name is not None:
+          tmetrics.counter(name).inc()
         with cls._lock:
           watches = list(cls._active)
         for watch in watches:
           watch._observe_event(event)
 
       def on_duration(event: str, duration: float, **kwargs):
+        if event == _BACKEND_COMPILE_DURATION:
+          tmetrics.counter("compile_cache.backend_compiles").inc()
         with cls._lock:
           watches = list(cls._active)
         for watch in watches:
@@ -229,6 +253,21 @@ class CompileWatch:
       monitoring.register_event_listener(on_event)
       monitoring.register_event_duration_secs_listener(on_duration)
       cls._installed = True
+
+  @classmethod
+  def install_tap(cls) -> None:
+    """Installs the jax.monitoring listeners WITHOUT opening a watch:
+    the registry counters above start accumulating for the process
+    lifetime. Trainers call this at entry so compile-cache traffic —
+    especially warm-path recompiles — shows up in their logs. The
+    counter names are touched on EVERY call (listener install is
+    once-per-process) so the keys exist in the registry — at zero —
+    even before the first cache event or after a registry reset."""
+    for name in ("compile_cache.hits", "compile_cache.misses",
+                 "compile_cache.requests",
+                 "compile_cache.backend_compiles"):
+      tmetrics.counter(name)
+    cls._install()
 
   def _observe_event(self, event: str) -> None:
     # Compiles can run on startup-overlap threads; counter updates

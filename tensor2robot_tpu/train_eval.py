@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tensor2robot_tpu import config as gin
+from tensor2robot_tpu import telemetry
 from tensor2robot_tpu.data.abstract_input_generator import (
     AbstractInputGenerator,
     Mode,
@@ -51,10 +52,20 @@ for _noisy in ("orbax", "absl"):
 
 
 class MetricLogger:
-  """Scalar metric sink: stdout + JSONL file per tag (train/eval)."""
+  """Scalar metric sink: stdout + JSONL file per tag (train/eval).
 
-  def __init__(self, model_dir: str):
+  Every record is the unified telemetry envelope
+  ``{"step", "wall", "role", "payload"}`` (telemetry.records — the
+  ISSUE 11 schema every producer shares: this trainer, anakin, the
+  fleet learner, the success-eval hooks). ``role`` defaults to the
+  process's telemetry role; read back with
+  `telemetry.records.read_records`, which also normalizes pre-envelope
+  files.
+  """
+
+  def __init__(self, model_dir: str, role: Optional[str] = None):
     self._model_dir = model_dir
+    self._role = role
     os.makedirs(model_dir, exist_ok=True)
     self._files: Dict[str, Any] = {}
 
@@ -63,7 +74,8 @@ class MetricLogger:
     if tag not in self._files:
       self._files[tag] = open(
           os.path.join(self._model_dir, f"metrics_{tag}.jsonl"), "a")
-    record = {"step": int(step), **scalars}
+    record = telemetry.records.make_record(step, scalars,
+                                           role=self._role)
     self._files[tag].write(json.dumps(record) + "\n")
     self._files[tag].flush()
     rendered = ", ".join(f"{k}={v:.5g}" for k, v in scalars.items())
@@ -470,13 +482,14 @@ def train_eval_model(
       for features, labels in prefetch_iter:
         if step >= max_train_steps:
           break
-        if k == 1:
-          state, metrics = train_callable(
-              state, features, labels,
-              jax.random.fold_in(step_rng, step))
-        else:
-          state, metrics = train_callable(state, features, labels,
-                                          step_rng, np.int32(step))
+        with telemetry.span("train.dispatch", step=step):
+          if k == 1:
+            state, metrics = train_callable(
+                state, features, labels,
+                jax.random.fold_in(step_rng, step))
+          else:
+            state, metrics = train_callable(state, features, labels,
+                                            step_rng, np.int32(step))
         step += k
         steps_since_log += k
         hook_list.after_step(step, metrics)
@@ -490,6 +503,14 @@ def train_eval_model(
           scalars["stall_fraction"] = min(
               max(stall_secs / max(dt, 1e-9), 0.0), 1.0)
           scalars["input_wait_fraction"] = prefetch_iter.wait_fraction(dt)
+          # Compile-cache traffic rides the train log (the CompileWatch
+          # tap publishes into the registry): a nonzero miss delta
+          # AFTER the first interval is a warm-path recompile.
+          scalars.update(telemetry.registry().scalars("compile_cache."))
+          telemetry.registry().gauge("train.steps_per_sec").set(
+              scalars["steps_per_sec"])
+          telemetry.registry().gauge("train.stall_fraction").set(
+              scalars["stall_fraction"])
           final_metrics = scalars
           t_last = time.time()
           steps_since_log = 0

@@ -15,6 +15,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from tensor2robot_tpu.telemetry.records import read_records
 from tensor2robot_tpu.envs import (
     AutoResetEnv,
     BatchedEnv,
@@ -327,8 +328,7 @@ class TestTrainAnakin:
         save_checkpoints_steps=16,
         seed=0)
     assert int(state.step) == 16
-    rows = [json.loads(line)
-            for line in open(tmp_path / "metrics_train.jsonl")]
+    rows = read_records(str(tmp_path / "metrics_train.jsonl"))
     assert rows, "no train metrics written"
     for row in rows:
       # Zero by construction: acting and training params are the same
@@ -380,8 +380,7 @@ class TestPodAnakin:
                          num_devices=2, **self.POD_KWARGS)
     # Returned state is the unreplicated device-0 replica.
     assert int(state.step) == 16
-    rows = [json.loads(line)
-            for line in open(tmp_path / "metrics_train.jsonl")]
+    rows = read_records(str(tmp_path / "metrics_train.jsonl"))
     assert rows
     for row in rows:
       # Zero by construction at ANY device count: acting params ARE
@@ -626,10 +625,8 @@ class TestPodAnakin:
     pod = train_anakin(
         learner=learner, model_dir=str(tmp_path / "pod"),
         num_devices=2, **self.POD_KWARGS)
-    rows_s = [json.loads(line) for line in
-              open(tmp_path / "single" / "metrics_train.jsonl")]
-    rows_p = [json.loads(line) for line in
-              open(tmp_path / "pod" / "metrics_train.jsonl")]
+    rows_s = read_records(str(tmp_path / "single" / "metrics_train.jsonl"))
+    rows_p = read_records(str(tmp_path / "pod" / "metrics_train.jsonl"))
     assert int(single.step) == int(pod.step) == 16
     assert np.isfinite(rows_p[-1]["loss"])
     # Same collection volume per iteration: both fill the ring at the
@@ -657,8 +654,7 @@ class TestScenarioSuccessEvalHook:
     hook.after_checkpoint(500, state.train_state, str(tmp_path))
     hook.after_checkpoint(1000, state.train_state, str(tmp_path))
 
-    rows = [json.loads(line) for line in
-            open(tmp_path / "metrics_scenario_eval.jsonl")]
+    rows = read_records(str(tmp_path / "metrics_scenario_eval.jsonl"))
     assert [r["step"] for r in rows] == [500, 1000]
     assert 0.0 <= rows[0]["success_rate"] <= 1.0
     assert "random_baseline_success_rate" in rows[0]
@@ -685,8 +681,7 @@ class TestScenarioSuccessEvalHook:
     hook.begin(learner.model, str(tmp_path))
     for step in (100, 200, 300):
       hook.after_checkpoint(step, state.train_state, str(tmp_path))
-    rows = [json.loads(line) for line in
-            open(tmp_path / "metrics_scenario_eval.jsonl")]
+    rows = read_records(str(tmp_path / "metrics_scenario_eval.jsonl"))
     assert [r["step"] for r in rows] == [100, 300]
 
 

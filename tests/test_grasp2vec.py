@@ -7,7 +7,6 @@ chance through the predictor, matched goals out-score mismatched ones —
 not just that shapes line up.
 """
 
-import json
 import os
 
 import jax
@@ -15,6 +14,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from tensor2robot_tpu.telemetry.records import read_records
 from tensor2robot_tpu import train_eval
 from tensor2robot_tpu.data.abstract_input_generator import Mode
 from tensor2robot_tpu.data.tfrecord_input_generator import (
@@ -159,14 +159,14 @@ class TestGrasp2VecEndToEnd:
 
   def test_loss_decreases(self, run):
     _, model_dir = run
-    records = [json.loads(line) for line in
-               open(os.path.join(model_dir, "metrics_train.jsonl"))]
+    records = read_records(
+        os.path.join(model_dir, "metrics_train.jsonl"))
     assert records[-1]["loss"] < records[0]["loss"]
 
   def test_in_batch_retrieval_learns(self, run):
     _, model_dir = run
-    records = [json.loads(line) for line in
-               open(os.path.join(model_dir, "metrics_train.jsonl"))]
+    records = read_records(
+        os.path.join(model_dir, "metrics_train.jsonl"))
     # Chance is ~1/16 plus duplicate mass; learned should be decisive.
     assert records[-1]["retrieval_top1"] > 0.5
 

@@ -5,7 +5,6 @@ meta-trained over random-phase sinusoids must do better AFTER inner
 adaptation than before.
 """
 
-import json
 import os
 
 import jax
@@ -29,6 +28,7 @@ from tensor2robot_tpu.specs import (
     TensorSpecStruct,
 )
 from tensor2robot_tpu.utils.mocks import MockT2RModel
+from tensor2robot_tpu.telemetry.records import read_records
 
 
 def _meta_model(**kwargs):
@@ -277,5 +277,5 @@ class TestPoseEnvMAML:
     )
     path = os.path.join(str(tmp_path / "pose_maml"),
                         "metrics_train.jsonl")
-    record = json.loads(open(path).readlines()[-1])
+    record = read_records(path)[-1]
     assert "post_adaptation_loss" in record

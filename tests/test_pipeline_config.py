@@ -17,7 +17,6 @@ under the ``stages`` param contract, `state_sharding` grew a
     serving model.
 """
 
-import json
 import os
 
 import numpy as np
@@ -27,6 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from tensor2robot_tpu.telemetry.records import read_records
 from tensor2robot_tpu.layers.pipelined_transformer import (
     PipelinedCausalTransformer,
 )
@@ -200,8 +200,8 @@ class TestPipelinedBCByConfig:
 
   def test_trains_and_checkpoints_on_the_stage_mesh(self, run):
     model, model_dir, state = run
-    records = [json.loads(line) for line in
-               open(os.path.join(model_dir, "metrics_train.jsonl"))]
+    records = read_records(
+        os.path.join(model_dir, "metrics_train.jsonl"))
     assert records, "no train metrics written"
     assert np.isfinite(records[-1]["loss"])
     # The trunk actually trained stage-stacked and stage-sharded.

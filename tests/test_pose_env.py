@@ -4,12 +4,12 @@ The reference's proof-of-life config (SURVEY.md §8 step 5): collect →
 TFRecord → train → checkpoint → predict → env eval, all spec-driven.
 """
 
-import json
 import os
 
 import numpy as np
 import pytest
 
+from tensor2robot_tpu.telemetry.records import read_records
 from tensor2robot_tpu import train_eval
 from tensor2robot_tpu.data.abstract_input_generator import Mode
 from tensor2robot_tpu.data.tfrecord_input_generator import (
@@ -96,14 +96,14 @@ class TestPoseEnvEndToEnd:
 
   def test_loss_decreases(self, run):
     _, model_dir = run
-    records = [json.loads(line) for line in
-               open(os.path.join(model_dir, "metrics_train.jsonl"))]
+    records = read_records(
+        os.path.join(model_dir, "metrics_train.jsonl"))
     assert records[-1]["mse"] < records[0]["mse"]
 
   def test_eval_metrics_written(self, run):
     _, model_dir = run
     path = os.path.join(model_dir, "metrics_eval.jsonl")
-    records = [json.loads(line) for line in open(path)]
+    records = read_records(path)
     assert records and "pose_error" in records[-1]
 
   def test_env_eval_through_predictor(self, run):
@@ -130,7 +130,6 @@ class TestPoseEnvEndToEnd:
 
   def test_success_eval_hook_logs_per_checkpoint(self, tmp_path):
     """The BASELINE protocol hook: success_rate per checkpoint."""
-    import json as json_lib
     from tensor2robot_tpu.hooks import SuccessEvalHook
 
     model = _tiny_model()
@@ -148,7 +147,7 @@ class TestPoseEnvEndToEnd:
                          "seed": 9})],
     )
     path = os.path.join(model_dir, "metrics_success_eval.jsonl")
-    records = [json_lib.loads(line) for line in open(path)]
+    records = read_records(path)
     # One protocol line per checkpoint, each carrying success_rate.
     assert [r["step"] for r in records] == [2, 4]
     assert all("success_rate" in r for r in records)

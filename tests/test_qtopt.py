@@ -22,6 +22,7 @@ from tensor2robot_tpu.research.qtopt import (
     train_qtopt,
 )
 from tensor2robot_tpu.specs import TensorSpecStruct, make_random_tensors
+from tensor2robot_tpu.telemetry.records import read_records
 
 RNG = jax.random.PRNGKey(0)
 
@@ -361,7 +362,7 @@ class TestGraspSuccessEval:
 
     # The per-checkpoint protocol line landed next to the train metrics.
     path = os.path.join(model_dir, "metrics_success_eval.jsonl")
-    records = [json.loads(line) for line in open(path)]
+    records = read_records(path)
     assert records and "success_rate" in records[-1]
     assert records[-1]["step"] == 400
 
@@ -466,8 +467,8 @@ class TestTrainQTOpt:
         prefill_random=True,
     )
     assert int(np.asarray(jax.device_get(state.step))) == 4
-    records = [json.loads(line) for line in
-               open(os.path.join(model_dir, "metrics_train.jsonl"))]
+    records = read_records(
+        os.path.join(model_dir, "metrics_train.jsonl"))
     assert "grad_steps_per_sec" in records[-1]
     # Feed-boundness is a logged trainer signal, bounded like a
     # fraction.

@@ -40,6 +40,7 @@ from tensor2robot_tpu.research.qtopt import (
     train_qtopt,
 )
 from tensor2robot_tpu.specs import TensorSpecStruct, make_random_tensors
+from tensor2robot_tpu.telemetry.records import read_records
 
 RNG = jax.random.PRNGKey(0)
 
@@ -631,9 +632,8 @@ class TestPrefetchDepth:
         hooks=[OnlineMarker()],
     )
     assert seen["buffer_size"] == 1
-    records = [json.loads(line) for line in
-               open(os.path.join(str(tmp_path / "depth"),
-                                 "metrics_train.jsonl"))]
+    records = read_records(os.path.join(str(tmp_path / "depth"),
+                                         "metrics_train.jsonl"))
     last = records[-1]
     assert "replay_fill" in last
     assert "replay_staleness_mean_steps" in last

@@ -36,6 +36,7 @@ from tensor2robot_tpu.startup import compile_cache
 from tensor2robot_tpu.startup import orchestrator
 from tensor2robot_tpu.utils import checkpoints as ckpt_lib
 from tensor2robot_tpu.utils.mocks import MockT2RModel
+from tensor2robot_tpu.telemetry.records import read_records
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -181,8 +182,8 @@ class TestOverlappedStartup:
         save_checkpoints_steps=10,
         log_every_steps=5,
     )
-    records = [json.loads(l) for l in open(
-        os.path.join(model_dir, "metrics_train.jsonl"))]
+    records = read_records(
+        os.path.join(model_dir, "metrics_train.jsonl"))
     assert len(records) >= 3
     for record in records:
       assert record["steps_per_sec"] > 0

@@ -15,6 +15,7 @@ from tensor2robot_tpu.data import Mode, RandomInputGenerator
 from tensor2robot_tpu.hooks import Hook
 from tensor2robot_tpu.utils import checkpoints as ckpt_lib
 from tensor2robot_tpu.utils.mocks import MockT2RModel
+from tensor2robot_tpu.telemetry.records import read_records
 
 
 class RecordingHook(Hook):
@@ -60,9 +61,8 @@ def test_train_eval_end_to_end(tmp_path):
   assert hook.checkpoints == [10, 20]
   assert len(hook.steps) == 20
   # Metrics written.
-  train_lines = open(
-      os.path.join(model_dir, "metrics_train.jsonl")).readlines()
-  records = [json.loads(l) for l in train_lines]
+  records = read_records(
+      os.path.join(model_dir, "metrics_train.jsonl"))
   assert records[-1]["step"] == 20
   assert "loss" in records[-1] and "steps_per_sec" in records[-1]
   # The feed-boundness signal rides every train log record: the share
@@ -118,8 +118,8 @@ def test_train_loss_decreases(tmp_path):
       save_checkpoints_steps=200,
       log_every_steps=10,
   )
-  records = [json.loads(l) for l in open(
-      os.path.join(model_dir, "metrics_train.jsonl"))]
+  records = read_records(
+      os.path.join(model_dir, "metrics_train.jsonl"))
   # Random targets: loss should shrink toward the target variance floor.
   assert records[-1]["loss"] < records[0]["loss"]
 

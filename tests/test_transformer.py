@@ -6,7 +6,6 @@ that clones actions conditioned on full episode history with a
 length-masked loss.
 """
 
-import json
 import os
 
 import numpy as np
@@ -27,6 +26,7 @@ from tensor2robot_tpu.research.vrgripper import (
     collect_demo_episodes,
 )
 from tensor2robot_tpu.specs import TensorSpecStruct
+from tensor2robot_tpu.telemetry.records import read_records
 
 IMG = 24  # matches the per-step BC closed-loop test scale
 
@@ -202,8 +202,8 @@ class TestTransformerBC:
 
   def test_loss_decreases(self, run):
     _, model_dir = run
-    records = [json.loads(line) for line in
-               open(os.path.join(model_dir, "metrics_train.jsonl"))]
+    records = read_records(
+        os.path.join(model_dir, "metrics_train.jsonl"))
     assert records[-1]["mse"] < records[0]["mse"] * 0.7
 
   def test_beats_zero_action_baseline(self, run):
@@ -416,8 +416,8 @@ class TestMoETransformerBC:
     )
 
     model, model_dir = run_moe
-    records = [json.loads(line) for line in
-               open(os.path.join(model_dir, "metrics_train.jsonl"))]
+    records = read_records(
+        os.path.join(model_dir, "metrics_train.jsonl"))
     assert records[-1]["mse"] < records[0]["mse"] * 0.7
     assert "aux_loss" in records[-1]  # experts routed during training
     policy = _restored_context_policy(model, model_dir)
