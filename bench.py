@@ -2497,7 +2497,8 @@ def main():
     # Tier-1 smoke of the on-device envs bench path: tiny env/model,
     # the full subprocess topology (virtual mesh, pmap scale-out,
     # interleaved trainer, the 2-virtual-device pod device-scaling
-    # leg, parity pin), NO detail-file write.
+    # leg — pmap AND jit+shard_map programs — parity pin), NO
+    # detail-file write.
     smoke = bench_envs(dry_run=True)
     scaleout = smoke.get("anakin_scaleout") or {}
     print(json.dumps({
@@ -2518,6 +2519,15 @@ def main():
         "device_scaling_lag_steps": [
             row["param_refresh_lag_steps"]
             for row in smoke["device_scaling"]["rows"]],
+        # The ISSUE-12 leg: the jit+shard_map pod program on the
+        # rules seam, ZeRO update sharded over the pod axis — runs
+        # NEXT TO the pmap leg on the same 2-virtual-device mesh.
+        "shardmap_grad_steps_per_sec": {
+            str(row["devices"]): row["grad_steps_per_sec"]
+            for row in smoke["device_scaling"]["shardmap_rows"]},
+        "shardmap_lag_steps": [
+            row["param_refresh_lag_steps"]
+            for row in smoke["device_scaling"]["shardmap_rows"]],
         "pose_parity_reward_max_abs_diff":
             smoke["pose_parity"]["reward_max_abs_diff"],
         "pose_parity_image_bitwise":

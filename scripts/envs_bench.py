@@ -212,12 +212,12 @@ def main() -> None:
 
   from tensor2robot_tpu.envs import train_anakin
 
-  def anakin_last_log_row(num_devices, kwargs):
+  def anakin_last_log_row(num_devices, kwargs, **extra):
     """One --trainer=anakin training; returns the LAST log window's
     metrics row (warm: the first window absorbs the compile)."""
     with tempfile.TemporaryDirectory() as tmp:
       train_anakin(learner=learner, model_dir=tmp, env=env, seed=0,
-                   num_devices=num_devices, **kwargs)
+                   num_devices=num_devices, **extra, **kwargs)
       return read_records(os.path.join(tmp, "metrics_train.jsonl"))[-1]
 
   with tempfile.TemporaryDirectory() as tmp:
@@ -284,6 +284,32 @@ def main() -> None:
                                          scale_kwargs["batch_size"])),
         "param_refresh_lag_steps": row["param_refresh_lag_steps"],
     })
+  # --- shard_map pod leg (ISSUE 12): the jit+shard_map program on
+  # the rules seam, head-to-head against the pmap rows above (same
+  # config, same mesh) WITH the ZeRO weight-update sharding composed
+  # over the pod axis — the composition pmap warn-ignores. Rows >= 2
+  # devices (D=1 is the bitwise twin of the pmap program; the jit row
+  # above already anchors that point).
+  sm_counts = [c for c in scale_counts if c >= 2]
+  shardmap_rows = []
+  for count in sm_counts:
+    row = anakin_last_log_row(count, scale_kwargs,
+                              pod_program="shard_map",
+                              shard_weight_update=True,
+                              sharding_rules="qtopt")
+    shardmap_rows.append({
+        "devices": count,
+        "program": "jit+shard_map (pod, zero update)",
+        "env_steps_per_sec": round(row["env_steps_per_sec"], 1),
+        "grad_steps_per_sec": round(row["grad_steps_per_sec"], 2),
+        "bellman_batches_per_sec": round(
+            row.get("bellman_batches_per_sec",
+                    row["grad_steps_per_sec"]), 2),
+        "global_batch_size": int(row.get("global_batch_size",
+                                         scale_kwargs["batch_size"])),
+        "param_refresh_lag_steps": row["param_refresh_lag_steps"],
+    })
+
   device_scaling = {
       "config": {
           "num_envs_total": scale_kwargs["num_envs"],
@@ -292,10 +318,14 @@ def main() -> None:
               scale_kwargs["train_batches_per_iter"],
           "per_device_batch": scale_kwargs["batch_size"],
           "note": ("total envs fixed (strong scaling on collection); "
-                   "per-device Bellman batch fixed, gradients "
-                   "pmean'd — global batch = D x per_device_batch"),
+                   "per-device Bellman batch fixed — global batch = "
+                   "D x per_device_batch. pmap rows pmean gradients; "
+                   "shardmap rows train the GLOBAL pod-sharded batch "
+                   "under GSPMD with the ZeRO update sharded on the "
+                   "pod axis (shard_weight_update=True)"),
       },
       "rows": scale_rows,
+      "shardmap_rows": shardmap_rows,
       "grad_steps_speedup_at_max_devices": round(
           scale_rows[-1]["grad_steps_per_sec"]
           / scale_rows[0]["grad_steps_per_sec"], 2),
@@ -303,6 +333,15 @@ def main() -> None:
           scale_rows[-1]["env_steps_per_sec"]
           / scale_rows[0]["env_steps_per_sec"], 2),
   }
+  if shardmap_rows:
+    device_scaling["shardmap_vs_pmap_at_max_devices"] = {
+        "grad_steps_ratio": round(
+            shardmap_rows[-1]["grad_steps_per_sec"]
+            / scale_rows[-1]["grad_steps_per_sec"], 2),
+        "env_steps_ratio": round(
+            shardmap_rows[-1]["env_steps_per_sec"]
+            / scale_rows[-1]["env_steps_per_sec"], 2),
+    }
 
   result = {
       "device_kind": devices[0].device_kind,

@@ -45,8 +45,10 @@ fleet_rc=$?
 
 # The envs smoke includes the pod device-scaling leg: a REAL (tiny)
 # 2-virtual-device pmap'd collect-and-learn training next to the PR-9
-# single-device program (ISSUE 10).
-echo "--- envs bench smoke (bench.py --envs --dry-run; 2-device pod leg) ---"
+# single-device program (ISSUE 10), plus the jit+shard_map pod
+# program on the rules seam with the ZeRO update sharded over the
+# pod axis (ISSUE 12) head-to-head on the same 2-device mesh.
+echo "--- envs bench smoke (bench.py --envs --dry-run; 2-device pod legs: pmap + shard_map) ---"
 env JAX_PLATFORMS=cpu python bench.py --envs --dry-run
 envs_rc=$?
 

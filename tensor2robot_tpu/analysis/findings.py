@@ -42,6 +42,8 @@ RULE_CATALOG: Dict[str, Tuple[str, str]] = {
                       "configurable"),
     "GIN106": ("gin", "include/import statement failed to resolve"),
     "GIN107": ("gin", "Config statement failed to parse"),
+    "GIN108": ("gin", "Sharding rules table fails its model family: "
+                      "unmatched param or dead regex"),
     # JAX tracing-hazard linter (family "jax")
     "JAX201": ("jax", "Host sync (block_until_ready/.item()/device_get/"
                       "float(arg)) inside traced code"),

@@ -255,9 +255,60 @@ def discover_configs(paths: Sequence[str]) -> List[str]:
   return list(iter_files(paths, suffix=".gin"))
 
 
+def run_sharding_rules_checks(families=None) -> List[Finding]:
+  """GIN108: every sharding rules table matches its model family.
+
+  For each family table in `parallel.rules.FAMILY_RULES`, builds the
+  family's canonical param templates (abstract — `jax.eval_shape`,
+  nothing materializes) and reports:
+
+    * UNMATCHED-PARAM — a param path no rule in the table matches
+      (that leaf would raise at placement time, minutes into a run);
+    * DEAD-REGEX — a rule matching no param of the family (a typo'd
+      or stale regex silently mis-routing placements; the table's
+      final catch-all default is exempt).
+
+  ``families`` overrides the registry for tests: a mapping
+  ``{name: (rules, [param_trees])}``.
+  """
+  from tensor2robot_tpu.parallel import rules as rules_lib
+
+  rel = os.path.join("tensor2robot_tpu", "parallel", "rules.py")
+  findings: List[Finding] = []
+  if families is None:
+    families = {}
+    for name in sorted(rules_lib.FAMILY_RULES):
+      try:
+        families[name] = (rules_lib.family_rules(name),
+                          rules_lib.family_param_templates(name))
+      except Exception as e:  # noqa: BLE001 — report, don't crash lint
+        # One broken family's template must not blind the check to
+        # the others: report it and keep validating the rest.
+        findings.append(Finding(
+            "GIN108", rel, 0, "",
+            f"family {name!r}: param template construction failed: "
+            f"{e}"))
+  for name, (rules, templates) in families.items():
+    unmatched, dead = rules_lib.check_rules_coverage(rules, templates)
+    for path in unmatched:
+      findings.append(Finding(
+          "GIN108", rel, 0, "",
+          f"family {name!r}: param {path!r} matches no sharding "
+          "rule"))
+    for pattern in dead:
+      findings.append(Finding(
+          "GIN108", rel, 0, "",
+          f"family {name!r}: rule {pattern!r} matches no param of "
+          "the family (dead regex)"))
+  return findings
+
+
 def run_gin_rules(paths: Sequence[str], root: str,
                   extra_modules: Sequence[str] = ()) -> List[Finding]:
-  """Validates every .gin under `paths` (files or directories)."""
+  """Validates every .gin under `paths` (files or directories), plus
+  the GIN108 sharding-rules family-coverage check (the rules tables
+  are config the same way the .gin files are — declarative inputs a
+  typo silently breaks)."""
   findings: List[Finding] = []
   failed = ensure_registrations(extra_modules)
   for module in failed:
@@ -267,5 +318,6 @@ def run_gin_rules(paths: Sequence[str], root: str,
         "configs referencing it will misvalidate"))
   for config in discover_configs(paths):
     findings.extend(validate_config_file(config, root))
+  findings.extend(run_sharding_rules_checks())
   findings.sort(key=lambda f: (f.path, f.line, f.rule))
   return findings

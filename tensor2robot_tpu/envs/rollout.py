@@ -230,7 +230,10 @@ def _build_env(env_family: str, model) -> FunctionalEnv:
                    "('pose', 'procgen') and no env was passed")
 
 
-# The pmap axis name of the pod-mode SPMD program (docs/ENVS.md).
+# The pod axis name of the pod-mode SPMD program (docs/ENVS.md): the
+# pmap device axis in the pmap program, and the NAMED MESH AXIS env
+# shards / replay rings / the ZeRO update ride in the shard_map
+# program (docs/SHARDING.md).
 POD_AXIS = "pod"
 
 
@@ -266,7 +269,10 @@ def train_anakin(
     cem_population: Optional[int] = None,
     cem_iterations: Optional[int] = None,
     num_devices: Optional[int] = None,
+    pod_program: str = "pmap",
+    sharding_rules: Optional[str] = None,
     shard_weight_update: bool = False,
+    update_shard_min_size: int = 2 ** 10,
     hooks: Iterable = (),
     seed: int = 0,
 ):
@@ -287,8 +293,8 @@ def train_anakin(
 
     * ``None`` (default) — the single-device jitted program (PR-9
       semantics, unchanged and bitwise-preserved).
-    * ``0`` / ``D`` — POD MODE: the ENTIRE iteration is one pmap'd
-      SPMD program over all / the first ``D`` local devices
+    * ``0`` / ``D`` — POD MODE: the ENTIRE iteration is one SPMD
+      program over all / the first ``D`` local devices
       (Podracer's full Anakin diagram, PAPERS.md). Each device runs
       ``num_envs / D`` envs feeding its OWN replay-ring shard (a
       ``[D, ...]`` leaf of the donated carry) and samples its OWN
@@ -305,15 +311,40 @@ def train_anakin(
       restores the learner exactly and re-replicates, and a pod
       checkpoint resumes on any device count (including ``None``).
 
+  ``pod_program`` selects the pod-mode SPMD substrate (docs/
+  SHARDING.md "The shard_map pod program"):
+
+    * ``"pmap"`` (default) — the PR-10 program: one pmap'd replica per
+      device, gradients pmean'd over the hard device axis.
+    * ``"shard_map"`` — ONE jitted program over a named `pod` mesh
+      axis: env shards, per-device replay rings, and sampled Bellman
+      batches ride ``PartitionSpec("pod")`` through a `shard_map`
+      collect stage, while the K Bellman train steps run as plain
+      GSPMD jit on the pod-sharded global batch (gradient all-reduce
+      inserted by the compiler). At ``num_devices=1`` the program is
+      bitwise-pinned against the pmap program (tests/test_envs.py,
+      the PR-10 FMA-less subprocess methodology). Because training is
+      jit+mesh, ``shard_weight_update`` COMPOSES with the pod axis
+      here — the composition pmap could never express.
+
+  ``sharding_rules`` optionally names a `parallel.FAMILY_RULES` table
+  (e.g. ``"qtopt"``); the shard_map program derives the param
+  placement through that table on the pod mesh (resolving to
+  replicated on a pod-only mesh — anything else raises, since the
+  collect stage broadcasts params).
+
   ``shard_weight_update=True`` composes the PR-6 ZeRO-style update
-  sharding where the mesh supports it: in the single-program path the
-  optimizer is wrapped with `optimizers.shard_weight_update` over
-  `parallel.mesh.create_mesh()` (moments live sharded across steps; a
-  1-device mesh is the pinned bitwise no-op). In pod mode each pmap
-  replica is a single-device program — there is no mesh for the GSPMD
-  constraint to act on — so the flag is ignored with a warning (the
-  pmean'd replicated update IS the pod path's distributed-update
-  story; see docs/ENVS.md).
+  sharding where a mesh exists for the GSPMD constraint to act on: in
+  the single-program path the optimizer is wrapped with
+  `optimizers.shard_weight_update` over `parallel.mesh.create_mesh()`
+  (moments live sharded across steps; a 1-device mesh is the pinned
+  bitwise no-op). In the shard_map pod program the wrap rides the POD
+  mesh axis (``axis="pod"``): gradients reduce-scatter over the pod,
+  each device updates 1/D of each weight's moments, and one
+  all-gather republishes params — optimizer state genuinely sharded
+  across the pod (spec-pinned by tests). Only the legacy pmap program
+  still warn-ignores the flag (each pmap replica is a single-device
+  program with no mesh); use ``pod_program="shard_map"`` there.
 
   The iteration quantum is `train_qtopt`'s ``steps_per_dispatch``:
   every cadence must be a multiple of ``train_batches_per_iter``, and
@@ -340,7 +371,11 @@ def train_anakin(
   if env is None:
     env = _build_env(env_family, learner.model)
 
+  if pod_program not in ("pmap", "shard_map"):
+    raise ValueError(f"pod_program={pod_program!r} not in "
+                     "('pmap', 'shard_map')")
   spmd = num_devices is not None
+  use_shard_map = spmd and pod_program == "shard_map"
   if spmd:
     local = jax.local_devices()
     d = len(local) if num_devices == 0 else int(num_devices)
@@ -371,26 +406,47 @@ def train_anakin(
   from tensor2robot_tpu.startup.compile_cache import CompileWatch
   CompileWatch.install_tap()
 
+  from tensor2robot_tpu.parallel import mesh as mesh_lib
+
   mesh = None
+  pod_mesh = None
+  if use_shard_map:
+    # The named pod mesh the shard_map program (and the ZeRO update)
+    # rides. Axis name POD_AXIS — PartitionSpec(POD_AXIS) IS the env-
+    # shard/ring/batch layout.
+    pod_mesh = mesh_lib.create_mesh({POD_AXIS: d}, devices=devices)
+  # The keyed wrap is RE-INSTALLED on every invocation — identity when
+  # the flag is off or warn-ignored — so a previous run's mesh-pinned
+  # ZeRO wrapper on this (possibly reused) learner can never leak into
+  # a run that didn't ask for it.
+  swu_wrapper = lambda tx: tx  # noqa: E731
   if shard_weight_update:
-    if spmd:
+    from tensor2robot_tpu.models import optimizers as opt_lib
+    if use_shard_map:
+      # The composition the shard_map port exists for: training is
+      # jit+mesh, so the ZeRO constraint acts on the POD axis —
+      # reduce-scatter'd grads, 1/D of each weight's moments per
+      # device, one all-gather republishing params. No warn-ignore.
+      swu_wrapper = lambda tx: opt_lib.shard_weight_update(  # noqa: E731
+          tx, pod_mesh, min_size_to_shard=update_shard_min_size,
+          axis=POD_AXIS)
+    elif spmd:
       # Each pmap replica is a single-device program: the GSPMD
       # sharding constraint `optimizers.shard_weight_update` rides on
-      # needs a jit+mesh program to act on. The pod path's
-      # distributed-update story is the pmean'd replicated update.
+      # needs a jit+mesh program to act on. The shard_map pod program
+      # composes the two; pmap keeps the pmean'd replicated update.
       log.warning(
-          "shard_weight_update=True is ignored in pod mode "
-          "(num_devices=%s): pmap replicas are single-device "
-          "programs; use the single-program path on a mesh host for "
-          "ZeRO-style update sharding.", num_devices)
+          "shard_weight_update=True is ignored by the pmap pod "
+          "program (num_devices=%s): pmap replicas are single-device "
+          "programs. Use pod_program='shard_map' to shard the update "
+          "across the pod axis.", num_devices)
     else:
-      from tensor2robot_tpu.models import optimizers as opt_lib
-      from tensor2robot_tpu.parallel import mesh as mesh_lib
       mesh = mesh_lib.create_mesh()
-      # Wrap BEFORE the state exists so tx is final when the step
-      # traces (the train_qtopt wiring).
-      learner.model.wrap_optimizer(
-          lambda tx: opt_lib.shard_weight_update(tx, mesh))
+      swu_wrapper = lambda tx: opt_lib.shard_weight_update(  # noqa: E731
+          tx, mesh, min_size_to_shard=update_shard_min_size)
+  # Wrap BEFORE the state exists so tx is final when the step traces
+  # (the train_qtopt wiring).
+  learner.model.wrap_optimizer(swu_wrapper, key="shard_weight_update")
 
   rng = jax.random.PRNGKey(seed)
   state = learner.create_state(rng, batch_size=2)
@@ -399,12 +455,48 @@ def train_anakin(
     log.info("Resuming anakin QT-Opt from step %d", resume_step)
     state = ckpt_lib.restore_state(model_dir, like=state,
                                    step=resume_step)
+  from tensor2robot_tpu.parallel import sharding as sharding_lib
+
+  state_shardings = None
   if mesh is not None:
-    from tensor2robot_tpu.parallel import sharding as sharding_lib
     # Moments must STAY sharded across steps: place the carried state
     # with the update sharding so the jitted iteration round-trips it.
     state = jax.device_put(
-        state, sharding_lib.train_state_update_sharding(mesh, state))
+        state, sharding_lib.train_state_update_sharding(
+            mesh, state, min_size_to_shard=update_shard_min_size))
+  if use_shard_map:
+    from jax.sharding import NamedSharding, PartitionSpec
+    if sharding_rules is not None:
+      # The rules seam: param placement derives from the family table
+      # on the pod mesh. A pod-only mesh has no fsdp/model axes, so
+      # every placement resolves to replicated — which the collect
+      # stage (params broadcast into shard_map) REQUIRES; a mesh/table
+      # combination that shards params fails loudly here.
+      from tensor2robot_tpu.parallel import rules as rules_lib
+      param_specs = rules_lib.match_partition_rules(
+          rules_lib.family_rules(sharding_rules),
+          state.train_state.params, pod_mesh)
+      bad = [rules_lib.tree_path_str(path)
+             for path, spec in
+             jax.tree_util.tree_leaves_with_path(
+                 param_specs,
+                 is_leaf=lambda x: isinstance(x, PartitionSpec))
+             if spec != PartitionSpec()]
+      if bad:
+        raise ValueError(
+            "the shard_map pod program broadcasts params into the "
+            f"collect stage; rules table {sharding_rules!r} shards "
+            f"{bad[:3]} on the pod mesh")
+    if shard_weight_update:
+      # ZeRO over the pod axis: moments sharded P("pod"), everything
+      # else (params, targets, batch stats, step) replicated.
+      state_shardings = sharding_lib.train_state_update_sharding(
+          pod_mesh, state, min_size_to_shard=update_shard_min_size,
+          axis=POD_AXIS)
+    else:
+      repl = NamedSharding(pod_mesh, PartitionSpec())
+      state_shardings = jax.tree_util.tree_map(lambda _: repl, state)
+    state = jax.device_put(state, state_shardings)
   step = int(np.asarray(jax.device_get(state.step)))
   if k > 1 and step % k and step < max_train_steps:
     metric_logger.close()
@@ -417,7 +509,21 @@ def train_anakin(
       learner, env, per_env, rollout_length, epsilon=epsilon,
       cem_population=cem_population, cem_iterations=cem_iterations)
   init_key = jax.random.PRNGKey(seed + 2)
-  if spmd:
+  if use_shard_map:
+    from jax.sharding import PartitionSpec as P
+    # Same per-device key schedule as the pmap program (D=1 uses the
+    # key itself), but the reset runs under shard_map: each mesh shard
+    # resets its own per_env envs and the results assemble into
+    # GLOBAL [num_envs] leaves sharded P("pod") — the layout the
+    # whole program keeps them in.
+    init_keys = (init_key[None] if d == 1 else
+                 jnp.stack([jax.random.fold_in(init_key, i)
+                            for i in range(d)]))
+    sm_init = mesh_lib.shard_map_compat(
+        lambda ks: init_fn(ks[0]), pod_mesh,
+        in_specs=P(POD_AXIS), out_specs=P(POD_AXIS))
+    env_states = jax.jit(sm_init)(init_keys)
+  elif spmd:
     # Device i resets its own env shard from fold_in(key, i); D=1
     # uses the key itself so the shard equals the single-device batch.
     init_keys = (init_key[None] if d == 1 else
@@ -433,10 +539,13 @@ def train_anakin(
     # observations (device-0 shard in pod mode) — before anything
     # traces the quantized tower.
     sample = min(per_env, 64)
+    # Pod layouts: pmap carries a leading device dim (device-0 shard
+    # at [0, :sample]); shard_map keeps GLOBAL [num_envs] leaves, so
+    # the first rows ARE device-0's shard.
     obs0 = jax.jit(jax.vmap(env.observe))(
         jax.tree_util.tree_map(
-            (lambda x: x[0, :sample]) if spmd else
-            (lambda x: x[:sample]), env_states))
+            (lambda x: x[0, :sample]) if (spmd and not use_shard_map)
+            else (lambda x: x[:sample]), env_states))
     learner.calibrate(state, {
         "image": obs0["image"],
         "action": jax.random.uniform(
@@ -445,13 +554,31 @@ def train_anakin(
             minval=-1.0, maxval=1.0),
     })
 
-  lead = (d,) if spmd else ()
-  replay = {
-      key: jnp.zeros(lead + (capacity,) + tuple(sp.shape),
-                     dtype=sp.dtype)
-      for key, sp in spec.items()}
-  size0 = jnp.zeros(lead, jnp.int32)
-  ptr0 = jnp.zeros(lead, jnp.int32)
+  if use_shard_map:
+    # GLOBAL ring: [D·capacity] rows sharded P("pod") — device i owns
+    # rows [i·capacity, (i+1)·capacity), its per-device ring shard.
+    # size/ptr are per-device-identical, so they live as replicated
+    # scalars instead of pmap's [D] per-device copies.
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    pod_sharding = NamedSharding(pod_mesh, P(POD_AXIS))
+    repl_sharding = NamedSharding(pod_mesh, P())
+    replay = {
+        key: jax.device_put(
+            jnp.zeros((d * capacity,) + tuple(sp.shape),
+                      dtype=sp.dtype), pod_sharding)
+        for key, sp in spec.items()}
+    size0 = jax.device_put(jnp.zeros((), jnp.int32), repl_sharding)
+    ptr0 = jax.device_put(jnp.zeros((), jnp.int32), repl_sharding)
+    env_states = jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, pod_sharding), env_states)
+  else:
+    lead = (d,) if spmd else ()
+    replay = {
+        key: jnp.zeros(lead + (capacity,) + tuple(sp.shape),
+                       dtype=sp.dtype)
+        for key, sp in spec.items()}
+    size0 = jnp.zeros(lead, jnp.int32)
+    ptr0 = jnp.zeros(lead, jnp.int32)
   step_rng = jax.random.PRNGKey(seed + 1)
   axis = POD_AXIS if spmd else None
 
@@ -498,7 +625,123 @@ def train_anakin(
       metrics["param_checksum"] = _param_checksum(qstate)
     return (qstate, states, ring, size, ptr), metrics
 
-  if spmd:
+  def make_shard_map_iteration():
+    """The jit+shard_map pod iteration (docs/SHARDING.md).
+
+    One jitted program over the named pod mesh, two regimes inside:
+
+      * COLLECT under `shard_map` — each mesh shard rolls its env
+        shard, inserts into its ring shard, and samples its K
+        per-device Bellman batches; env states, rings, and batches
+        ride ``P("pod")``.
+      * Each Bellman step = GRADS under `shard_map` (per-device
+        forward/backward on the device's own batch, one `lax.pmean`
+        — the pmap program's exact semantics, and the fast path on
+        every backend) + UPDATE as plain GSPMD jit
+        (`learner.apply_gradients`: elementwise weight-sized math,
+        which under ``shard_weight_update`` the ZeRO constraints
+        shard across the pod — each device updates 1/D of every
+        weight's moments, one all-gather republishes params). This
+        is the "Automatic Cross-Replica Sharding of Weight Update"
+        split verbatim: everything data-parallel except the update.
+
+    PRNG schedule is the pmap program's exactly (device folds apply
+    only at d>1), so ``num_devices=1`` reproduces it bitwise — the
+    pinned equivalence in tests/test_envs.py.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    def sm_collect(acting_ts, step0, states, ring, size_new, ptr_in,
+                   key):
+      if d > 1:
+        key = jax.random.fold_in(key, jax.lax.axis_index(POD_AXIS))
+      key_collect, _ = jax.random.split(key)
+      states, batch = collect_fn(acting_ts, states, key_collect)
+      ring = {
+          name: jax.lax.dynamic_update_slice(
+              ring[name], batch[name],
+              (ptr_in,) + (0,) * (ring[name].ndim - 1))
+          for name in ring}
+      minibatches = []
+      for j in range(k):
+        base = jax.random.fold_in(step_rng, step0 + j)
+        key_sample, _ = jax.random.split(base)
+        if d > 1:
+          key_sample = jax.random.fold_in(
+              key_sample, jax.lax.axis_index(POD_AXIS))
+        idx = jax.random.randint(key_sample, (batch_size,), 0,
+                                 size_new)
+        minibatches.append({name: ring[name][idx] for name in ring})
+      stacked = {
+          name: jnp.stack([mb[name] for mb in minibatches])
+          for name in ring}
+      reward = jnp.mean(batch["reward"])
+      if d > 1:
+        reward = jax.lax.pmean(reward, POD_AXIS)
+      return states, ring, stacked, reward
+
+    sm_collect_sharded = mesh_lib.shard_map_compat(
+        sm_collect, pod_mesh,
+        in_specs=(P(), P(), P(POD_AXIS), P(POD_AXIS), P(), P(), P()),
+        out_specs=(P(POD_AXIS), P(POD_AXIS), P(None, POD_AXIS), P()))
+
+    def sm_grads(acting, mb, key_net):
+      # Per-device backward, the pmap train_body's exact schedule:
+      # d>1 folds the device index into the net key (per-device
+      # dropout/CEM streams), d=1 does not; gradients/stats/metrics
+      # come out pmean'd (replicated).
+      if d > 1:
+        key_net = jax.random.fold_in(key_net,
+                                     jax.lax.axis_index(POD_AXIS))
+      minibatch = TensorSpecStruct.from_flat_dict(mb)
+      return learner.train_grads(acting, minibatch, key_net,
+                                 axis_name=POD_AXIS)
+
+    sm_grads_sharded = mesh_lib.shard_map_compat(
+        sm_grads, pod_mesh,
+        in_specs=(P(), P(POD_AXIS), P()),
+        out_specs=(P(), P(), P()))
+
+    def sm_iteration(carry, key):
+      qstate, states, ring, size, ptr = carry
+      size_new = jnp.minimum(size + rows_d, capacity)
+      # Acting reads only params/batch_stats; the opt_state (sharded
+      # under ZeRO) must not cross the shard_map boundary replicated.
+      acting_ts = qstate.train_state.replace(opt_state=())
+      step0 = qstate.train_state.step
+      states, ring, minibatches, collect_reward = sm_collect_sharded(
+          acting_ts, step0, states, ring, size_new, ptr, key)
+      new_ptr = (ptr + rows_d) % capacity
+
+      def train_body(st, mb):
+        base = jax.random.fold_in(step_rng, st.train_state.step)
+        key_net = jax.random.split(base)[1]
+        acting = st.replace(
+            train_state=st.train_state.replace(opt_state=()))
+        grads, new_stats, metrics = sm_grads_sharded(acting, mb,
+                                                     key_net)
+        # The GSPMD half: elementwise update (ZeRO-sharded when
+        # shard_weight_update wrapped the tx) + Polyak target sync.
+        return learner.apply_gradients(st, grads, new_stats), metrics
+
+      qstate, metrics_seq = jax.lax.scan(train_body, qstate,
+                                         minibatches)
+      metrics = jax.tree_util.tree_map(lambda m: m[-1], metrics_seq)
+      metrics["collect_reward_mean"] = collect_reward
+      metrics["replay_fill"] = size_new.astype(jnp.float32) / capacity
+      if shard_weight_update:
+        # Moments STAY pod-sharded across iterations: constrain the
+        # carried-out state so the boundary never all-gathers them.
+        qstate = jax.tree_util.tree_map(
+            jax.lax.with_sharding_constraint, qstate, state_shardings)
+      return (qstate, states, ring, size_new, new_ptr), metrics
+
+    return sm_iteration
+
+  if use_shard_map:
+    anakin_step = jax.jit(make_shard_map_iteration(),
+                          donate_argnums=(0,))
+  elif spmd:
     anakin_step = jax.pmap(iteration, axis_name=POD_AXIS,
                            devices=devices, in_axes=(0, None),
                            donate_argnums=(0,))
@@ -507,8 +750,9 @@ def train_anakin(
     anakin_step = jax.jit(iteration, donate_argnums=(0,))
 
   def device0(tree):
-    """The device-0 replica view (identity in single-program mode)."""
-    if not spmd:
+    """The device-0 replica view (identity in single-program and
+    shard_map modes, whose arrays are global)."""
+    if not spmd or use_shard_map:
       return tree
     return jax.tree_util.tree_map(lambda x: x[0], tree)
 
@@ -533,7 +777,10 @@ def train_anakin(
       hook_list.after_step(step, device0(metrics))
       if step % log_every_steps == 0 or step == max_train_steps:
         scalars = jax.device_get(metrics)
-        if spmd:
+        if spmd and not use_shard_map:
+          # shard_map metrics are already global scalars, and its
+          # params are ONE logical replicated array — there are no
+          # per-replica copies to checksum-compare.
           checks = np.asarray(scalars.pop("param_checksum"))
           if np.unique(checks).size != 1:
             raise RuntimeError(

@@ -148,12 +148,32 @@ class AbstractT2RModel(ModelInterface):
       self._tx = self._create_optimizer_fn()
     return self._tx
 
-  def wrap_optimizer(self, wrapper: Callable) -> None:
+  def wrap_optimizer(self, wrapper: Callable,
+                     key: Optional[str] = None) -> None:
     """Replaces the optimizer with `wrapper(tx)` — the trainer-side
     hook for mesh-dependent transformations (e.g.
     `optimizers.shard_weight_update`, which needs the mesh that only
-    the training loop knows). Call before the step is traced."""
-    self._tx = wrapper(self.tx)
+    the training loop knows). Call before the step is traced.
+
+    ``key`` makes the wrap IDEMPOTENT per key: re-wrapping with the
+    same key replaces the previous incarnation instead of stacking on
+    top of it. Trainers that may be invoked repeatedly on one model
+    (bench device-scaling rows, successive runs in one process) MUST
+    pass a key — a stacked stale wrapper would otherwise pin the tx
+    to a dead mesh's devices. Keyless wraps keep the raw composing
+    behavior.
+    """
+    if key is None:
+      self._tx = wrapper(self.tx)
+      return
+    if getattr(self, "_tx_keyed_base", None) is None:
+      self._tx_keyed_base = self.tx
+      self._tx_keyed_wrappers = {}
+    self._tx_keyed_wrappers[key] = wrapper
+    tx = self._tx_keyed_base
+    for keyed_wrapper in self._tx_keyed_wrappers.values():
+      tx = keyed_wrapper(tx)
+    self._tx = tx
 
   AUX_LOSS_OUTPUT = "_aux_loss"
 
@@ -362,6 +382,28 @@ class AbstractT2RModel(ModelInterface):
     same way (cross-replica batch stats; device-0 metrics are global
     means). `axis_name=None` (the default) is the unchanged
     single-program step.
+
+    Composition of the two halves below — `train_grads` (forward/
+    backward, collective-synchronized) and `apply_gradients` (the
+    elementwise weight-sized update). The shard_map pod program calls
+    the halves SEPARATELY so the backward runs per-device under
+    `shard_map` while the update runs as jit+mesh GSPMD — the seam
+    the ZeRO weight-update sharding composes through
+    (docs/SHARDING.md).
+    """
+    grads, new_stats, metrics = self.train_grads(
+        state, features, labels, rng, axis_name=axis_name)
+    return self.apply_gradients(state, grads, new_stats), metrics
+
+  def train_grads(self, state: TrainState, features, labels,
+                  rng: jax.Array, axis_name: Optional[str] = None
+                  ) -> Tuple[Any, Any, Dict[str, jax.Array]]:
+    """The forward/backward half of `train_step`.
+
+    Returns ``(grads, new_batch_stats, metrics)`` — gradients, batch
+    stats, and loss metrics, already `lax.pmean`'d over `axis_name`
+    when given. Everything collective lives here; no optimizer state
+    is touched.
     """
     grad_fn = jax.value_and_grad(self._loss_for_grad(), has_aux=True)
     (loss, (scalars, new_stats)), grads = grad_fn(
@@ -372,19 +414,30 @@ class AbstractT2RModel(ModelInterface):
       scalars = jax.lax.pmean(scalars, axis_name)
       if new_stats:
         new_stats = jax.lax.pmean(new_stats, axis_name)
+    metrics = {"loss": loss,
+               "grad_norm": optax.global_norm(grads),
+               **scalars}
+    return grads, new_stats, metrics
+
+  def apply_gradients(self, state: TrainState, grads: Any,
+                      new_stats: Any) -> TrainState:
+    """The optimizer half of `train_step`: tx.update + apply.
+
+    Elementwise weight-sized math (plus whatever the configured optax
+    chain adds), so under a mesh whose tx is wrapped with
+    `optimizers.shard_weight_update` the GSPMD constraints shard it
+    cross-replica — each device updates 1/N of every weight's
+    moments.
+    """
     updates, new_opt_state = self.tx.update(grads, state.opt_state,
                                             state.params)
     new_params = optax.apply_updates(state.params, updates)
-    new_state = state.replace(
+    return state.replace(
         step=state.step + 1,
         params=new_params,
         batch_stats=new_stats,
         opt_state=new_opt_state,
     )
-    metrics = {"loss": loss,
-               "grad_norm": optax.global_norm(grads),
-               **scalars}
-    return new_state, metrics
 
   def eval_step(self, state: TrainState, features,
                 labels) -> Dict[str, jax.Array]:

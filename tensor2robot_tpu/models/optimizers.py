@@ -24,6 +24,7 @@ def shard_weight_update(
     tx: optax.GradientTransformation,
     mesh,
     min_size_to_shard: int = 2 ** 10,
+    axis: Optional[str] = None,
 ) -> optax.GradientTransformation:
   """Shards `tx`'s update across the mesh's data-parallel replicas.
 
@@ -42,14 +43,23 @@ def shard_weight_update(
   carried state's in/out shardings so the moments STAY sharded across
   steps. On a 1-device (or data-less) mesh every constraint is a
   no-op and the step is bitwise identical to `tx` (pinned by tests).
+
+  ``axis`` selects the mesh axis the update shards over (default: the
+  jit-mesh `data` axis). The shard_map pod program passes its `pod`
+  axis — the composition that retires the old pod-mode warn-ignore
+  path (docs/SHARDING.md).
   """
   import jax
 
   from tensor2robot_tpu.parallel import sharding as sharding_lib
+  from tensor2robot_tpu.parallel.mesh import DATA_AXIS
+
+  update_axis = DATA_AXIS if axis is None else axis
 
   def _constrain(tree):
     shardings = sharding_lib.data_update_sharding(
-        mesh, tree, min_size_to_shard=min_size_to_shard)
+        mesh, tree, min_size_to_shard=min_size_to_shard,
+        axis=update_axis)
     return jax.tree_util.tree_map(
         jax.lax.with_sharding_constraint, tree, shardings)
 

@@ -32,6 +32,21 @@ from tensor2robot_tpu.parallel.ring_attention import (
     ring_attention,
     sequence_sharding,
 )
+from tensor2robot_tpu.parallel.rules import (
+    FAMILY_RULES,
+    ColumnParallel,
+    Replicate,
+    ShardLargest,
+    ShardLeading,
+    check_rules_coverage,
+    family_param_templates,
+    family_rules,
+    family_sharding,
+    make_shard_and_gather_fns,
+    match_partition_rules,
+    specs_to_shardings,
+    tree_path_str,
+)
 from tensor2robot_tpu.parallel.sharding import (
     data_update_sharding,
     expert_sharding,

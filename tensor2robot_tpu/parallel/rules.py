@@ -1,0 +1,449 @@
+"""The regex-rules sharding seam: one table per model family.
+
+This is the `match_partition_rules` / `make_shard_and_gather_fns`
+pattern (SNIPPETS.md; the pjit pod-mesh story of "Scalable Training of
+Language Models using JAX pjit and TPUv4", PAPERS.md) adapted to this
+repo's CNN/MLP-scale families: sharding decisions live in declarative
+RULES TABLES — ordered ``(param-path regex, placement)`` pairs — and
+everything that places a pytree (the mesh strategies in
+`parallel/sharding.py`, checkpoint restore, the shard_map pod program)
+consumes a table instead of growing its own tree-walk.
+
+Because robot-scale leaves vary in rank and size, a rule's value is a
+PLACEMENT, not always a bare PartitionSpec: a placement resolves
+against the mesh and the leaf's shape (divisibility, min-size) to a
+concrete `PartitionSpec`. The grammar:
+
+  * ``Replicate()`` — always `P()`.
+  * ``ShardLargest(axis)`` — the fsdp/zero rule: shard the largest
+    axis-divisible dim; replicate when the axis is absent, the leaf is
+    under ``min_size_to_shard``, or nothing divides.
+  * ``ColumnParallel()`` — the megatron rule: 2D+ kernels split their
+    output dim on `model` (+`fsdp` on the input dim when divisible);
+    degrades to ``ShardLargest(fsdp)`` when the mesh has no `model`
+    axis.
+  * ``ShardLeading(axis)`` — stacked weights (MoE experts, pipeline
+    stages): leading dim on `axis`, RAISING on an indivisible leading
+    dim (silent replication would defeat the memory win); degrades to
+    ``ShardLargest(fsdp)`` when the axis is absent.
+  * a literal ``PartitionSpec`` — used verbatim.
+
+Rules are first-match-wins (``re.search`` over the '/'-joined param
+path, the flax convention). `FAMILY_RULES` holds one table per
+research family; the t2rcheck rule GIN108 statically checks that every
+family table COVERS every param of its family's canonical models and
+carries no dead regexes. `docs/SHARDING.md` is the narrative spec.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Callable, Dict, List, Sequence, Tuple, Union
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tensor2robot_tpu.parallel.mesh import (
+    EXPERT_AXIS,
+    FSDP_AXIS,
+    MODEL_AXIS,
+    STAGE_AXIS,
+)
+
+
+# ---------------------------------------------------------------------------
+# Placement grammar
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Replicate:
+  """Every shard holds the full leaf."""
+
+  def spec(self, mesh: Mesh, shape, min_size: int, path: str) -> P:
+    del mesh, shape, min_size, path
+    return P()
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardLargest:
+  """Largest axis-divisible dim on `axis` (the fsdp/zero leaf rule).
+
+  Ties break toward the LOWEST dim index (stable sort), leaves smaller
+  than the call's ``min_size_to_shard`` replicate (latency > memory
+  win at that size), and a missing mesh axis replicates everything —
+  exactly the pre-rules `fsdp_sharding` semantics, regression-pinned
+  by tests/test_sharding_rules.py.
+  """
+
+  axis: str = FSDP_AXIS
+
+  def spec(self, mesh: Mesh, shape, min_size: int, path: str) -> P:
+    del path
+    if self.axis not in mesh.axis_names:
+      return P()
+    size = mesh.shape[self.axis]
+    if not shape or int(np.prod(shape)) < min_size:
+      return P()
+    order = sorted(range(len(shape)), key=lambda i: -shape[i])
+    for dim in order:
+      if shape[dim] % size == 0:
+        entries = [None] * len(shape)
+        entries[dim] = self.axis
+        return P(*entries)
+    return P()
+
+
+@dataclasses.dataclass(frozen=True)
+class ColumnParallel:
+  """Megatron-style column parallel for dense kernels.
+
+  2D+ leaves split their output (last) dim on `model_axis` when
+  divisible, additionally splitting the input (second-to-last) dim on
+  `fsdp_axis` when present and divisible; rank-1 leaves may still
+  split on `model_axis`. Without a `model_axis` in the mesh this IS
+  ``ShardLargest(fsdp_axis)`` — the pre-rules `tensor_parallel_
+  sharding` fallback.
+  """
+
+  model_axis: str = MODEL_AXIS
+  fsdp_axis: str = FSDP_AXIS
+
+  def spec(self, mesh: Mesh, shape, min_size: int, path: str) -> P:
+    if self.model_axis not in mesh.axis_names:
+      return ShardLargest(self.fsdp_axis).spec(mesh, shape, min_size,
+                                               path)
+    tp = mesh.shape[self.model_axis]
+    if not shape or int(np.prod(shape)) < min_size:
+      return P()
+    if len(shape) >= 2 and shape[-1] % tp == 0:
+      entries = [None] * len(shape)
+      entries[-1] = self.model_axis
+      if (self.fsdp_axis in mesh.axis_names
+          and shape[-2] % mesh.shape[self.fsdp_axis] == 0):
+        entries[-2] = self.fsdp_axis
+      return P(*entries)
+    if shape[-1] % tp == 0:
+      return P(*([None] * (len(shape) - 1)), self.model_axis)
+    return P()
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardLeading:
+  """Stacked weights: leading dim on `axis` (MoE experts, stages).
+
+  An indivisible leading dim RAISES when the axis is present —
+  silently replicating weights a pod expects sharded would defeat the
+  memory win sharding exists for. With the axis absent the leaf
+  follows ``ShardLargest(fallback_axis)`` (the sequential-fallback
+  layout).
+  """
+
+  axis: str
+  fallback_axis: str = FSDP_AXIS
+
+  def spec(self, mesh: Mesh, shape, min_size: int, path: str) -> P:
+    if self.axis not in mesh.axis_names:
+      return ShardLargest(self.fallback_axis).spec(mesh, shape,
+                                                   min_size, path)
+    size = mesh.shape[self.axis]
+    if not shape or shape[0] % size != 0:
+      raise ValueError(
+          f"stacked weight {path!r} has leading dim {shape[:1]} not "
+          f"divisible by {self.axis!r} axis size {size}")
+    return P(self.axis)
+
+
+Placement = Union[Replicate, ShardLargest, ColumnParallel,
+                  ShardLeading, P]
+Rules = Sequence[Tuple[str, Placement]]
+
+
+# ---------------------------------------------------------------------------
+# The matcher
+# ---------------------------------------------------------------------------
+
+
+def _entry_str(entry) -> str:
+  """One path entry as a string (DictKey/GetAttrKey/SequenceKey)."""
+  for attr in ("key", "name", "idx"):
+    value = getattr(entry, attr, None)
+    if value is not None:
+      return str(value)
+  return str(entry)
+
+
+def tree_path_str(path) -> str:
+  """'/'-joined param path, the name rules tables match against."""
+  return "/".join(_entry_str(entry) for entry in path)
+
+
+def _resolve(placement: Placement, mesh: Mesh, shape, min_size: int,
+             path: str) -> P:
+  if isinstance(placement, P):
+    return placement
+  return placement.spec(mesh, tuple(shape), min_size, path)
+
+
+def match_partition_rules(
+    rules: Rules,
+    tree: Any,
+    mesh: Mesh,
+    min_size_to_shard: int = 2 ** 10,
+) -> Any:
+  """PartitionSpec pytree: first rule whose regex `search`es the
+  '/'-joined leaf path wins; its placement resolves against the mesh
+  and the leaf's shape. Works on arrays or ShapeDtypeStructs (anything
+  with `.shape`). Raises on a leaf no rule matches — tables are
+  expected to end in a catch-all, and t2rcheck GIN108 checks family
+  tables cover their families statically.
+  """
+  compiled = [(re.compile(pattern), placement)
+              for pattern, placement in rules]
+
+  def rule(path, leaf):
+    name = tree_path_str(path)
+    shape = getattr(leaf, "shape", ())
+    for regex, placement in compiled:
+      if regex.search(name):
+        return _resolve(placement, mesh, shape, min_size_to_shard,
+                        name)
+    raise ValueError(
+        f"no partition rule matched param {name!r} "
+        f"(table has {len(compiled)} rules; add a catch-all)")
+
+  return jax.tree_util.tree_map_with_path(rule, tree)
+
+
+def _is_spec_leaf(x) -> bool:
+  return isinstance(x, (P, jax.sharding.Sharding))
+
+
+def specs_to_shardings(mesh: Mesh, specs: Any) -> Any:
+  """PartitionSpec pytree → NamedSharding pytree over `mesh`."""
+  return jax.tree_util.tree_map(
+      lambda s: s if isinstance(s, jax.sharding.Sharding)
+      else NamedSharding(mesh, s),
+      specs, is_leaf=_is_spec_leaf)
+
+
+def make_shard_and_gather_fns(
+    mesh: Mesh, specs: Any
+) -> Tuple[Any, Any]:
+  """(shard_fns, gather_fns) pytrees of per-leaf callables.
+
+  ``shard_fn(host_array) -> device array`` placed per the spec —
+  restore-side: a checkpoint read on host lands directly in the target
+  layout, whatever mesh it was SAVED under. ``gather_fn(device_array)
+  -> np.ndarray`` fully gathered on host — save-side (and the
+  relayout pivot: gather under mesh A, shard under mesh B). The
+  checkpoint-portability contract `docs/SHARDING.md` documents;
+  roundtrip-pinned by tests/test_checkpoint_resharding.py.
+  """
+  shardings = specs_to_shardings(mesh, specs)
+
+  def make_shard_fn(sharding):
+    def shard_fn(x):
+      return jax.device_put(jax.numpy.asarray(x), sharding)
+    return shard_fn
+
+  def make_gather_fn(sharding):
+    del sharding
+
+    def gather_fn(x):
+      return np.asarray(jax.device_get(x))
+    return gather_fn
+
+  shard_fns = jax.tree_util.tree_map(make_shard_fn, shardings,
+                                     is_leaf=_is_spec_leaf)
+  gather_fns = jax.tree_util.tree_map(make_gather_fn, shardings,
+                                      is_leaf=_is_spec_leaf)
+  return shard_fns, gather_fns
+
+
+# ---------------------------------------------------------------------------
+# Per-family rules tables
+# ---------------------------------------------------------------------------
+
+# Shared rule fragments: stacked-expert weights (the `moe_expert_`
+# prefix is OWNED by `parallel/moe.MoEMLP` — this regex is the ONE
+# place the contract is spelled, replacing the old hard-coded prefix
+# special-case in `expert_sharding`) and stage-stacked pipeline
+# weights (`layers/pipelined_transformer.STAGE_PARAMS_NAME`).
+EXPERT_STACK_RE = r"(^|/)moe_expert_[^/]*$"
+STAGE_STACK_RE = r"(^|/)stages(/|$)"
+
+# One table per research family, matched against the '/'-joined param
+# paths of that family's canonical models (`family_param_templates`).
+# Ordered most-specific-first; every table ends in a ShardLargest
+# catch-all so optimizer mirrors and future params stay covered.
+# t2rcheck GIN108 pins coverage + no dead regexes.
+FAMILY_RULES: Dict[str, Rules] = {
+    "qtopt": (
+        (r"(^|/)(torso|head)_conv_[0-9]+/kernel$",
+         ShardLargest(FSDP_AXIS)),
+        (r"(^|/)(torso|head)_bn_[0-9]+/(bias|scale)$", Replicate()),
+        (r"(^|/)action_embed_[0-9]+/kernel$", ColumnParallel()),
+        (r"(^|/)q_head/dense_[0-9]+/kernel$", ColumnParallel()),
+        (r"/bias$", Replicate()),
+        (r".*", ShardLargest(FSDP_AXIS)),
+    ),
+    "pose_env": (
+        (r"(^|/)tower/conv_[0-9]+/kernel$", ShardLargest(FSDP_AXIS)),
+        (r"(^|/)tower/bn_[0-9]+/(bias|scale)$", Replicate()),
+        (r"(^|/)ssoftmax/log_temperature$", Replicate()),
+        (r"(^|/)head/dense_[0-9]+/kernel$", ColumnParallel()),
+        (r"(^|/)proj/kernel$", ColumnParallel()),
+        (r"/bias$", Replicate()),
+        (r".*", ShardLargest(FSDP_AXIS)),
+    ),
+    "grasp2vec": (
+        (r"(^|/)trunk/conv_init/kernel$", ShardLargest(FSDP_AXIS)),
+        (r"(^|/)stage[0-9]+_block[0-9]+/(conv[0-9]+|proj)/kernel$",
+         ShardLargest(FSDP_AXIS)),
+        (r"(^|/)(bn_init|bn[0-9]+|bn_proj)/(bias|scale)$",
+         Replicate()),
+        (r"(^|/)embed/kernel$", ColumnParallel()),
+        (r"/bias$", Replicate()),
+        (r".*", ShardLargest(FSDP_AXIS)),
+    ),
+    "vrgripper": (
+        (EXPERT_STACK_RE, ShardLeading(EXPERT_AXIS)),
+        (STAGE_STACK_RE, ShardLeading(STAGE_AXIS)),
+        (r"(^|/)moe/router$", Replicate()),
+        (r"(^|/)attn/(qkv|proj)/kernel$", ColumnParallel()),
+        (r"(^|/)mlp_(in|out)/kernel$", ColumnParallel()),
+        (r"(^|/)ln_[a-z0-9_]+/(bias|scale)$", Replicate()),
+        (r"(^|/)positions$", Replicate()),
+        (r"(^|/)tower/conv_[0-9]+/kernel$", ShardLargest(FSDP_AXIS)),
+        (r"(^|/)ssoftmax/log_temperature$", Replicate()),
+        (r"(^|/)(proj|joint_proj|embed|action_head)/kernel$",
+         ColumnParallel()),
+        (r"(^|/)trunk/dense_[0-9]+/kernel$", ColumnParallel()),
+        (r"/bias$", Replicate()),
+        (r".*", ShardLargest(FSDP_AXIS)),
+    ),
+    "meta_learning": (
+        (r"(^|/)inner_lr_log$", Replicate()),
+        (r"(^|/)tower/conv_[0-9]+/kernel$", ShardLargest(FSDP_AXIS)),
+        (r"(^|/)tower/bn_[0-9]+/(bias|scale)$", Replicate()),
+        (r"(^|/)ssoftmax/log_temperature$", Replicate()),
+        (r"(^|/)head/dense_[0-9]+/kernel$", ColumnParallel()),
+        (r"(^|/)proj/kernel$", ColumnParallel()),
+        (r"/bias$", Replicate()),
+        (r".*", ShardLargest(FSDP_AXIS)),
+    ),
+}
+
+
+def family_rules(family: str) -> Rules:
+  try:
+    return FAMILY_RULES[family]
+  except KeyError:
+    raise ValueError(
+        f"unknown model family {family!r}; known: "
+        f"{', '.join(sorted(FAMILY_RULES))}") from None
+
+
+def family_sharding(mesh: Mesh, tree: Any, family: str,
+                    min_size_to_shard: int = 2 ** 10) -> Any:
+  """NamedSharding pytree for `tree` under the family's rules table."""
+  return specs_to_shardings(mesh, match_partition_rules(
+      family_rules(family), tree, mesh,
+      min_size_to_shard=min_size_to_shard))
+
+
+_TEMPLATE_CACHE: Dict[str, List[Any]] = {}
+
+
+def family_param_templates(family: str) -> List[Any]:
+  """Abstract (eval_shape'd) param trees of the family's canonical
+  models — what GIN108 checks the rules table against. Tiny configs:
+  nothing materializes, nothing trains; variants that introduce
+  distinct param groups (MoE experts, pipeline stages) get their own
+  template so their regexes are exercised. Memoized: the templates
+  are static shape trees, and the GIN108 lint path may ask for them
+  repeatedly."""
+  cached = _TEMPLATE_CACHE.get(family)
+  if cached is not None:
+    return cached
+  templates = _build_family_param_templates(family)
+  _TEMPLATE_CACHE[family] = templates
+  return templates
+
+
+def _build_family_param_templates(family: str) -> List[Any]:
+
+  def abstract_params(model, batch_size: int = 2):
+    state = jax.eval_shape(
+        lambda rng: model.create_train_state(rng,
+                                             batch_size=batch_size),
+        jax.random.PRNGKey(0))
+    return state.params
+
+  if family == "qtopt":
+    from tensor2robot_tpu.research.qtopt.t2r_models import (
+        GraspingQModel,
+    )
+    return [abstract_params(GraspingQModel(
+        image_size=16, torso_filters=(8,), head_filters=(8,),
+        dense_sizes=(16,), action_dim=2))]
+  if family == "pose_env":
+    from tensor2robot_tpu.research.pose_env.pose_env_models import (
+        PoseEnvRegressionModel,
+    )
+    return [abstract_params(PoseEnvRegressionModel())]
+  if family == "grasp2vec":
+    from tensor2robot_tpu.research.grasp2vec.grasp2vec_model import (
+        Grasp2VecModel,
+    )
+    return [abstract_params(Grasp2VecModel())]
+  if family == "vrgripper":
+    from tensor2robot_tpu.research.vrgripper.vrgripper_models import (
+        VRGripperRegressionModel,
+    )
+    from tensor2robot_tpu.research.vrgripper.\
+        vrgripper_transformer_models import VRGripperTransformerModel
+    return [
+        abstract_params(VRGripperRegressionModel()),
+        abstract_params(VRGripperTransformerModel(
+            moe_experts=4, moe_every=2)),
+        abstract_params(VRGripperTransformerModel(
+            pipeline_stages=2, depth=2)),
+    ]
+  if family == "meta_learning":
+    from tensor2robot_tpu.meta_learning.maml_model import MAMLModel
+    from tensor2robot_tpu.research.pose_env.pose_env_models import (
+        PoseEnvRegressionModel,
+    )
+    return [abstract_params(
+        MAMLModel(base_model=PoseEnvRegressionModel(),
+                  learn_inner_lr=True))]
+  raise ValueError(f"unknown model family {family!r}")
+
+
+def check_rules_coverage(
+    rules: Rules, trees: Sequence[Any]
+) -> Tuple[List[str], List[str]]:
+  """(unmatched param paths, dead rule regexes) for a table against a
+  family's param trees — the static core of t2rcheck GIN108. The
+  table's FINAL rule is its declared default (catch-all) and is exempt
+  from dead-regex detection: a family whose named rules already cover
+  every param keeps its safety net without a finding."""
+  compiled = [(pattern, re.compile(pattern)) for pattern, _ in rules]
+  used = [False] * len(compiled)
+  unmatched: List[str] = []
+  for tree in trees:
+    for path, _ in jax.tree_util.tree_leaves_with_path(tree):
+      name = tree_path_str(path)
+      for index, (_, regex) in enumerate(compiled):
+        if regex.search(name):
+          used[index] = True
+          break
+      else:
+        unmatched.append(name)
+  dead = [pattern for (pattern, _), hit in
+          zip(compiled[:-1], used[:-1]) if not hit]
+  return unmatched, dead

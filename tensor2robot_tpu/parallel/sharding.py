@@ -1,27 +1,39 @@
-"""Parameter/optimizer sharding rules over the named mesh.
+"""Parameter/optimizer sharding strategies over the named mesh.
 
 The reference's only distribution strategy was TPUEstimator data
 parallelism (SURVEY.md §3 parallelism inventory). Here sharding is a
-first-class design axis: given a mesh with `fsdp` (zero-style parameter
-sharding) and/or `model` (tensor-parallel) axes, these helpers derive
-NamedShardings for every leaf of a param/opt pytree, and GSPMD inserts
-the all-gathers/reduce-scatters over ICI.
+first-class design axis — and since the rules-seam refactor every
+strategy is a RULES-TABLE SELECTION over `parallel/rules.py`'s
+`match_partition_rules` engine rather than a bespoke tree-walk: a
+strategy is an ordered (param-path regex → placement) table; the
+engine resolves placements against the mesh and each leaf's shape and
+GSPMD inserts the all-gathers/reduce-scatters over ICI.
 
-Heuristics (CNN/MLP-scale models; large transformers would add explicit
-per-layer rules):
+Strategy tables (docs/SHARDING.md):
   * fsdp: shard the LARGEST divisible dim of each leaf; leaves smaller
     than `min_size_to_shard` stay replicated (latency > memory win).
-  * model: dense kernels additionally split their output dim when
+  * tp: dense kernels additionally split their output dim when
     divisible (megatron-style column parallel) — opt-in.
+  * ep / pipeline: stacked expert / stage weights put their leading
+    dim on the `expert` / `stage` axis via the SHARED stack regexes
+    (`rules.EXPERT_STACK_RE`, `rules.STAGE_STACK_RE`) — the old
+    hard-coded `moe_expert_` prefix special-case in `expert_sharding`
+    is now one declarative rule.
+  * data / train_state_update: the ZeRO weight-update sharding
+    ("Automatic Cross-Replica Sharding of Weight Update in
+    Data-Parallel Training", PAPERS.md), parameterized by `axis` so it
+    composes with the shard_map pod program's `pod` axis as well as
+    the jit-mesh `data` axis.
+
+The pre-refactor outputs are regression-pinned spec-for-spec by
+tests/test_sharding_rules.py on the 8-device MULTICHIP axis.
 """
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any
 
-import jax
-import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from tensor2robot_tpu.parallel.mesh import (
     DATA_AXIS,
@@ -29,13 +41,28 @@ from tensor2robot_tpu.parallel.mesh import (
     FSDP_AXIS,
     MODEL_AXIS,
     STAGE_AXIS,
-    replicated,
+)
+from tensor2robot_tpu.parallel.rules import (
+    EXPERT_STACK_RE,
+    STAGE_STACK_RE,
+    ColumnParallel,
+    Replicate,
+    Rules,
+    ShardLargest,
+    ShardLeading,
+    match_partition_rules,
+    specs_to_shardings,
 )
 
+# Path segment naming a TrainState's optimizer collection — the seam
+# `train_state_update_sharding` keys the ZeRO moment sharding on.
+OPT_STATE_RE = r"(^|/)opt_state(/|$)"
 
-def _path_key_name(key) -> str:
-  """The string name of a pytree path entry (DictKey or GetAttrKey)."""
-  return str(getattr(key, "key", getattr(key, "name", "")))
+
+def _apply_rules(mesh: Mesh, tree: Any, rules: Rules,
+                 min_size_to_shard: int) -> Any:
+  return specs_to_shardings(mesh, match_partition_rules(
+      rules, tree, mesh, min_size_to_shard=min_size_to_shard))
 
 
 def fsdp_sharding(
@@ -49,24 +76,8 @@ def fsdp_sharding(
   (or too small) replicate. Optimizer states mirror their param leaf by
   construction (same shapes ⇒ same rule).
   """
-  if FSDP_AXIS not in mesh.axis_names:
-    repl = NamedSharding(mesh, P())
-    return jax.tree_util.tree_map(lambda _: repl, tree)
-  size = mesh.shape[FSDP_AXIS]
-
-  def rule(leaf):
-    shape = getattr(leaf, "shape", ())
-    if not shape or int(np.prod(shape)) < min_size_to_shard:
-      return NamedSharding(mesh, P())
-    order = sorted(range(len(shape)), key=lambda i: -shape[i])
-    for dim in order:
-      if shape[dim] % size == 0:
-        spec = [None] * len(shape)
-        spec[dim] = FSDP_AXIS
-        return NamedSharding(mesh, P(*spec))
-    return NamedSharding(mesh, P())
-
-  return jax.tree_util.tree_map(rule, tree)
+  return _apply_rules(mesh, tree, ((r".*", ShardLargest(FSDP_AXIS)),),
+                      min_size_to_shard)
 
 
 def tensor_parallel_sharding(
@@ -74,157 +85,93 @@ def tensor_parallel_sharding(
     tree: Any,
     min_size_to_shard: int = 2 ** 12,
 ) -> Any:
-  """Megatron-ish: 2D kernels split output dim on `model` (+fsdp on in-dim)."""
-  if MODEL_AXIS not in mesh.axis_names:
-    return fsdp_sharding(mesh, tree, min_size_to_shard)
-  tp = mesh.shape[MODEL_AXIS]
-  fsdp = mesh.shape.get(FSDP_AXIS, 1)
-  has_fsdp = FSDP_AXIS in mesh.axis_names
-
-  def rule(leaf):
-    shape = getattr(leaf, "shape", ())
-    if not shape or int(np.prod(shape)) < min_size_to_shard:
-      return NamedSharding(mesh, P())
-    if len(shape) >= 2 and shape[-1] % tp == 0:
-      spec = [None] * len(shape)
-      spec[-1] = MODEL_AXIS
-      if has_fsdp and shape[-2] % fsdp == 0:
-        spec[-2] = FSDP_AXIS
-      return NamedSharding(mesh, P(*spec))
-    if shape[-1] % tp == 0:
-      return NamedSharding(mesh, P(*([None] * (len(shape) - 1)),
-                                   MODEL_AXIS))
-    return NamedSharding(mesh, P())
-
-  return jax.tree_util.tree_map(rule, tree)
+  """Megatron-ish: 2D kernels split output dim on `model` (+fsdp on
+  in-dim); falls back to the fsdp rules on a model-less mesh."""
+  return _apply_rules(mesh, tree, ((r".*", ColumnParallel()),),
+                      min_size_to_shard)
 
 
 def expert_sharding(mesh: Mesh, tree: Any,
                     min_size_to_shard: int = 2 ** 10) -> Any:
   """fsdp rules + expert weights sharded over the `expert` axis.
 
-  Keys on the `MoEMLP` param-name contract: a leaf is an expert weight
-  iff its own name is ``moe_expert_``-prefixed — the stacked [E, ...]
-  expert weights. That prefix is OWNED by `MoEMLP` (`parallel/moe.py`
-  names every stacked expert param with it and nothing else may), so
-  the rule is mount-point independent: a trunk may instantiate its
-  MoEMLP under any module name and the experts still shard. (The old
-  contract additionally required the parent module to be literally
-  named ``moe``, which silently REPLICATED experts mounted under any
-  other name — round-5 advisor finding.) Matching leaves put their
-  leading expert dim on `expert`; an indivisible leading dim raises
-  (silently falling back to fsdp would replicate expert weights a pod
-  expects sharded). Everything else (router, attention, dense trunk —
-  and every optimizer mirror, which shares its param's path) follows
-  the fsdp rule. With no `expert` mesh axis this IS `fsdp_sharding`.
+  The stacked-expert rule is `rules.EXPERT_STACK_RE` — a leaf whose
+  own name is ``moe_expert_``-prefixed, the prefix OWNED by `MoEMLP`
+  (`parallel/moe.py` names every stacked expert param with it and
+  nothing else may). Mount-point independent: a trunk may instantiate
+  its MoEMLP under any module name and the experts still shard, and
+  optimizer mirrors (which nest the param path under opt-state
+  prefixes) match the same rule. An indivisible leading expert dim
+  raises (silently falling back to fsdp would replicate expert weights
+  a pod expects sharded). With no `expert` mesh axis this IS
+  `fsdp_sharding`.
   """
-  if EXPERT_AXIS not in mesh.axis_names:
-    return fsdp_sharding(mesh, tree, min_size_to_shard)
-  size = mesh.shape[EXPERT_AXIS]
-
-  def rule(path, leaf):
-    shape = getattr(leaf, "shape", ())
-    is_expert = bool(
-        path and _path_key_name(path[-1]).startswith("moe_expert_"))
-    if is_expert:
-      if not shape or shape[0] % size != 0:
-        raise ValueError(
-            f"expert weight {jax.tree_util.keystr(path)} has leading "
-            f"dim {shape[:1]} not divisible by expert axis size {size}")
-      return NamedSharding(mesh, P(EXPERT_AXIS))
-    # A single array is its own pytree: fsdp_sharding returns the
-    # one NamedSharding its rule picks for this leaf.
-    return fsdp_sharding(mesh, leaf, min_size_to_shard)
-
-  return jax.tree_util.tree_map_with_path(rule, tree)
+  return _apply_rules(
+      mesh, tree,
+      ((EXPERT_STACK_RE, ShardLeading(EXPERT_AXIS)),
+       (r".*", ShardLargest(FSDP_AXIS))),
+      min_size_to_shard)
 
 
 def pipeline_sharding(mesh: Mesh, tree: Any,
                       min_size_to_shard: int = 2 ** 10) -> Any:
   """fsdp rules + stage-stacked weights sharded over the `stage` axis.
 
-  Keys on the `PipelinedCausalTransformer` param-name contract
-  (`layers/pipelined_transformer.STAGE_PARAMS_NAME`): every leaf under
-  a path segment named ``stages`` carries a leading [num_stages] dim
-  and puts it on `stage` — each device materializes only its own
-  stage's weights (and their optimizer mirrors, which share the path).
-  An indivisible leading dim raises: silently replicating stage
-  weights would defeat the memory win pipelining exists for. With no
-  `stage` mesh axis this IS `fsdp_sharding` (the sequential-fallback
-  layout `pipeline_apply` runs against).
+  The stack rule is `rules.STAGE_STACK_RE`: every leaf under a path
+  segment named ``stages`` (`layers/pipelined_transformer.
+  STAGE_PARAMS_NAME`) carries a leading [num_stages] dim and puts it
+  on `stage` — each device materializes only its own stage's weights
+  (and their optimizer mirrors, which share the path). An indivisible
+  leading dim raises. With no `stage` mesh axis this IS
+  `fsdp_sharding` (the sequential-fallback layout `pipeline_apply`
+  runs against).
   """
-  if STAGE_AXIS not in mesh.axis_names:
-    return fsdp_sharding(mesh, tree, min_size_to_shard)
-  size = mesh.shape[STAGE_AXIS]
-
-  def rule(path, leaf):
-    shape = getattr(leaf, "shape", ())
-    if any(_path_key_name(key) == "stages" for key in path):
-      if not shape or shape[0] % size != 0:
-        raise ValueError(
-            f"stage-stacked weight {jax.tree_util.keystr(path)} has "
-            f"leading dim {shape[:1]} not divisible by stage axis "
-            f"size {size}")
-      return NamedSharding(mesh, P(STAGE_AXIS))
-    return fsdp_sharding(mesh, leaf, min_size_to_shard)
-
-  return jax.tree_util.tree_map_with_path(rule, tree)
+  return _apply_rules(
+      mesh, tree,
+      ((STAGE_STACK_RE, ShardLeading(STAGE_AXIS)),
+       (r".*", ShardLargest(FSDP_AXIS))),
+      min_size_to_shard)
 
 
 def data_update_sharding(
     mesh: Mesh,
     tree: Any,
     min_size_to_shard: int = 2 ** 10,
+    axis: str = DATA_AXIS,
 ) -> Any:
-  """Largest-divisible-dim sharding over the DATA axis for each leaf.
+  """Largest-divisible-dim sharding over `axis` for each leaf.
 
   The weight-update sharding of "Automatic Cross-Replica Sharding of
   Weight Update in Data-Parallel Training" (PAPERS.md): params stay
   replicated for the forward/backward, but the optimizer's gradients,
-  moments, and update math are sharded across the data-parallel
-  replicas — GSPMD turns the gradient all-reduce into reduce-scatter,
-  each replica updates 1/N of the weights, and one all-gather
-  republishes them. Same leaf rule as `fsdp_sharding`, on `data`.
+  moments, and update math are sharded across the replicas — GSPMD
+  turns the gradient all-reduce into reduce-scatter, each replica
+  updates 1/N of the weights, and one all-gather republishes them.
+  Same leaf rule as `fsdp_sharding`, on `axis` (the jit-mesh `data`
+  axis by default; the shard_map pod program passes its `pod` axis).
   """
-  if DATA_AXIS not in mesh.axis_names:
-    repl = NamedSharding(mesh, P())
-    return jax.tree_util.tree_map(lambda _: repl, tree)
-  size = mesh.shape[DATA_AXIS]
-
-  def rule(leaf):
-    shape = getattr(leaf, "shape", ())
-    if not shape or int(np.prod(shape)) < min_size_to_shard:
-      return NamedSharding(mesh, P())
-    order = sorted(range(len(shape)), key=lambda i: -shape[i])
-    for dim in order:
-      if shape[dim] % size == 0:
-        spec = [None] * len(shape)
-        spec[dim] = DATA_AXIS
-        return NamedSharding(mesh, P(*spec))
-    return NamedSharding(mesh, P())
-
-  return jax.tree_util.tree_map(rule, tree)
+  return _apply_rules(mesh, tree, ((r".*", ShardLargest(axis)),),
+                      min_size_to_shard)
 
 
 def train_state_update_sharding(mesh: Mesh, state: Any,
-                                min_size_to_shard: int = 2 ** 10
-                                ) -> Any:
+                                min_size_to_shard: int = 2 ** 10,
+                                axis: str = DATA_AXIS) -> Any:
   """Shardings for a TrainState-bearing pytree with the optimizer
-  state sharded over the data axis and everything else replicated.
+  state sharded over `axis` and everything else replicated.
 
-  Keys on the `TrainState.opt_state` field name: every leaf under a
-  path segment named ``opt_state`` follows `data_update_sharding`;
-  params/batch_stats/step (and a QTOptState's target net) replicate.
-  Pass the result as the state's device_put/in_shardings AND
-  out_shardings — a replicated out_sharding on opt_state would
-  all-gather the moments back every step and erase the win.
+  Keys on the `TrainState.opt_state` field name (`OPT_STATE_RE`):
+  every leaf under a path segment named ``opt_state`` follows
+  `data_update_sharding`; params/batch_stats/step (and a QTOptState's
+  target net) replicate. Pass the result as the state's device_put/
+  in_shardings AND out_shardings — a replicated out_sharding on
+  opt_state would all-gather the moments back every step and erase
+  the win.
   """
-  def rule(path, leaf):
-    if any(_path_key_name(key) == "opt_state" for key in path):
-      return data_update_sharding(mesh, leaf, min_size_to_shard)
-    return NamedSharding(mesh, P())
-
-  return jax.tree_util.tree_map_with_path(rule, state)
+  return _apply_rules(
+      mesh, state,
+      ((OPT_STATE_RE, ShardLargest(axis)), (r".*", Replicate())),
+      min_size_to_shard)
 
 
 def replicated_sharding(mesh: Mesh, tree: Any,
@@ -235,8 +182,7 @@ def replicated_sharding(mesh: Mesh, tree: Any,
   (most robot-scale networks), and the baseline the collective-audit
   tests diff fsdp/tp against.
   """
-  del min_size_to_shard
-  return jax.tree_util.tree_map(lambda _: replicated(mesh), tree)
+  return _apply_rules(mesh, tree, ((r".*", P()),), min_size_to_shard)
 
 
 def state_sharding(mesh: Mesh, state: Any,

@@ -309,7 +309,22 @@ class QTOptLearner:
     the replicated learner state stays replicated by construction.
     The q_next/target metrics are pmean'd too (device-0 reports the
     global means).
+
+    Composition of `train_grads` + `apply_gradients` — the split the
+    shard_map pod program drives directly (per-device backward under
+    shard_map, GSPMD weight update; docs/SHARDING.md).
     """
+    grads, new_stats, metrics = self.train_grads(
+        state, transitions, rng, axis_name=axis_name)
+    return self.apply_gradients(state, grads, new_stats), metrics
+
+  def train_grads(self, state: QTOptState,
+                  transitions: TensorSpecStruct, rng: jax.Array,
+                  axis_name: Optional[str] = None
+                  ) -> Tuple[Any, Any, Dict[str, jax.Array]]:
+    """The forward/backward half of `train_step`: CEM Bellman targets
+    + critic gradients (pmean'd over `axis_name`), no optimizer
+    update. Returns ``(grads, new_batch_stats, metrics)``."""
     flat = transitions.to_flat_dict()
     rng_cem, rng_net = jax.random.split(rng)
 
@@ -336,12 +351,8 @@ class QTOptLearner:
 
     labels = TensorSpecStruct.from_flat_dict(
         {"target_q": target[:, None]})
-    new_ts, metrics = self._model.train_step(ts, features, labels,
-                                             rng_net,
-                                             axis_name=axis_name)
-    new_target = jax.tree_util.tree_map(
-        functools.partial(_polyak, self._tau),
-        new_ts.params, state.target_params)
+    grads, new_stats, metrics = self._model.train_grads(
+        ts, features, labels, rng_net, axis_name=axis_name)
     metrics["q_next_mean"] = jnp.mean(q_next)
     metrics["target_mean"] = jnp.mean(target)
     if axis_name is not None:
@@ -349,8 +360,17 @@ class QTOptLearner:
                                              axis_name)
       metrics["target_mean"] = jax.lax.pmean(metrics["target_mean"],
                                              axis_name)
-    return QTOptState(train_state=new_ts,
-                      target_params=new_target), metrics
+    return grads, new_stats, metrics
+
+  def apply_gradients(self, state: QTOptState, grads: Any,
+                      new_stats: Any) -> QTOptState:
+    """The update half: critic optimizer step + Polyak target sync."""
+    new_ts = self._model.apply_gradients(state.train_state, grads,
+                                         new_stats)
+    new_target = jax.tree_util.tree_map(
+        functools.partial(_polyak, self._tau),
+        new_ts.params, state.target_params)
+    return QTOptState(train_state=new_ts, target_params=new_target)
 
   # ---- on-robot / actor policy ----
 

@@ -125,12 +125,15 @@ def train_qtopt(
         seed=seed)
     replay_buffer.add(fill)
   rng = jax.random.PRNGKey(seed)
+  # Keyed re-wrap on EVERY invocation (identity when the flag is off):
+  # a reused learner must not keep a previous run's mesh-pinned ZeRO
+  # wrapper. Wrap BEFORE the state exists so tx is final when the step
+  # traces; init stays untouched (shardings come from placement).
+  swu_wrapper = lambda tx: tx  # noqa: E731
   if shard_weight_update:
-    # Wrap BEFORE the state exists so tx is final when the step
-    # traces; init stays untouched (shardings come from placement).
     from tensor2robot_tpu.models import optimizers as opt_lib
-    learner.model.wrap_optimizer(
-        lambda tx: opt_lib.shard_weight_update(tx, mesh))
+    swu_wrapper = lambda tx: opt_lib.shard_weight_update(tx, mesh)  # noqa: E731
+  learner.model.wrap_optimizer(swu_wrapper, key="shard_weight_update")
   state = learner.create_state(rng, batch_size=2)
   repl = mesh_lib.replicated(mesh)
   data_sharding = mesh_lib.batch_sharding(mesh)

@@ -28,6 +28,7 @@ from tensor2robot_tpu.telemetry import core
 from tensor2robot_tpu.telemetry import flightrec
 from tensor2robot_tpu.telemetry import merge
 from tensor2robot_tpu.telemetry import metrics
+from tensor2robot_tpu.telemetry import prometheus
 from tensor2robot_tpu.telemetry import records
 from tensor2robot_tpu.telemetry.core import (
     clock_offset_from_handshake,
@@ -49,6 +50,7 @@ __all__ = [
     "get_tracer",
     "merge",
     "metrics",
+    "prometheus",
     "records",
     "registry",
     "span",

@@ -1,0 +1,120 @@
+"""Prometheus text-format adapter over `MetricsRegistry.snapshot()`.
+
+The ROADMAP telemetry follow-on: the registry every subsystem already
+publishes into (replay, serving, data plane, trainers, compile cache)
+becomes scrapeable by an external Prometheus without any new
+instrumentation — this module only TRANSLATES the fixed snapshot
+schema (telemetry/metrics.py) into the text exposition format
+(version 0.0.4):
+
+  * counters  → ``<name>_total`` with ``# TYPE ... counter``;
+  * gauges    → ``<name>`` with ``# TYPE ... gauge``;
+  * histograms → CUMULATIVE ``<name>_bucket{le="..."}`` series (the
+    registry stores per-bucket counts; Prometheus wants running
+    totals) plus ``_sum``/``_count``, with ``le="+Inf"`` closing the
+    series.
+
+Metric names sanitize to ``[a-zA-Z_:][a-zA-Z0-9_:]*`` (dots and
+dashes — the registry's namespacing convention — become underscores).
+
+`serve()` is the ~endpoint: a daemon-threaded stdlib HTTP server
+answering ``GET /metrics``, snapshotting at scrape time. jax-free BY
+CONTRACT like the rest of the package (IMP401 worker-safe set) — an
+actor or data-plane worker can expose its own scrape port.
+"""
+
+from __future__ import annotations
+
+import http.server
+import re
+import threading
+from typing import Dict, Optional
+
+from tensor2robot_tpu.telemetry import metrics as metrics_lib
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _sanitize(name: str) -> str:
+  name = _NAME_RE.sub("_", name)
+  if not name or name[0].isdigit():
+    name = "_" + name
+  return name
+
+
+def _fmt(value) -> str:
+  return repr(float(value))
+
+
+def render_text(snapshot: Optional[Dict] = None,
+                prefix: str = "t2r_") -> str:
+  """One scrape body from a registry snapshot (default: the
+  process-wide registry, snapshotted now)."""
+  if snapshot is None:
+    snapshot = metrics_lib.registry().snapshot()
+  lines = []
+  for name, value in sorted(snapshot.get("counters", {}).items()):
+    metric = prefix + _sanitize(name)
+    if not metric.endswith("_total"):
+      metric += "_total"
+    lines += [f"# TYPE {metric} counter", f"{metric} {_fmt(value)}"]
+  for name, value in sorted(snapshot.get("gauges", {}).items()):
+    metric = prefix + _sanitize(name)
+    lines += [f"# TYPE {metric} gauge", f"{metric} {_fmt(value)}"]
+  for name, hist in sorted(snapshot.get("histograms", {}).items()):
+    metric = prefix + _sanitize(name)
+    lines.append(f"# TYPE {metric} histogram")
+    running = 0
+    for bound, count in zip(hist["bounds"], hist["counts"]):
+      running += count
+      lines.append(f'{metric}_bucket{{le="{_fmt(bound)}"}} {running}')
+    lines.append(f'{metric}_bucket{{le="+Inf"}} {hist["count"]}')
+    lines.append(f"{metric}_sum {_fmt(hist['sum'])}")
+    lines.append(f"{metric}_count {hist['count']}")
+  return "\n".join(lines) + "\n"
+
+
+class PrometheusEndpoint:
+  """``GET /metrics`` over a daemon-threaded stdlib HTTP server."""
+
+  def __init__(self, port: int = 0, host: str = "127.0.0.1",
+               prefix: str = "t2r_"):
+    endpoint = self
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+
+      def do_GET(self):  # noqa: N802 — stdlib handler contract
+        if self.path.split("?")[0] != "/metrics":
+          self.send_error(404)
+          return
+        body = render_text(prefix=endpoint._prefix).encode("utf-8")
+        self.send_response(200)
+        self.send_header("Content-Type", CONTENT_TYPE)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+      def log_message(self, *args):  # scrapes stay out of stderr
+        del args
+
+    self._prefix = prefix
+    self._server = http.server.ThreadingHTTPServer((host, port),
+                                                   Handler)
+    self.port = self._server.server_address[1]
+    self._thread = threading.Thread(
+        target=self._server.serve_forever, name="prometheus-scrape",
+        daemon=True)
+    self._thread.start()
+
+  def close(self) -> None:
+    self._server.shutdown()
+    self._server.server_close()
+    self._thread.join(timeout=5.0)
+
+
+def serve(port: int = 0, host: str = "127.0.0.1",
+          prefix: str = "t2r_") -> PrometheusEndpoint:
+  """Starts (and returns) the scrape endpoint; `port=0` picks a free
+  one (read it back from ``.port``)."""
+  return PrometheusEndpoint(port=port, host=host, prefix=prefix)

@@ -168,6 +168,47 @@ def _abstract_like(tree: Any) -> Any:
   return jax.tree_util.tree_map(leaf, tree)
 
 
+def reshard_like(like: Any, mesh, rules, *,
+                 min_size_to_shard: int = 2 ** 10) -> Any:
+  """Abstract twin of `like` carrying rules-table target shardings.
+
+  The restore half of the rules seam (`parallel/rules.py`,
+  docs/SHARDING.md): a checkpoint saved under ANY mesh layout restores
+  directly onto ANY other — pass the result as `restore_state`'s
+  ``like`` and every array lands placed per the table. `rules` is an
+  ordered (regex, placement) table (e.g. `parallel.family_rules(
+  "qtopt")` or a strategy table); ``mesh`` is the TARGET mesh.
+  """
+  from tensor2robot_tpu.parallel import rules as rules_lib
+
+  shardings = rules_lib.specs_to_shardings(
+      mesh, rules_lib.match_partition_rules(
+          rules, like, mesh, min_size_to_shard=min_size_to_shard))
+
+  def leaf(x, sharding):
+    shape = np.shape(x) if not hasattr(x, "shape") else x.shape
+    dtype = getattr(x, "dtype", None)
+    if dtype is None:
+      return x
+    return jax.ShapeDtypeStruct(shape, dtype, sharding=sharding)
+
+  return jax.tree_util.tree_map(leaf, like, shardings)
+
+
+def restore_state_on_mesh(model_dir: str, like: Any, mesh, rules,
+                          step: Optional[int] = None,
+                          min_size_to_shard: int = 2 ** 10) -> Any:
+  """`restore_state` with the target layout derived from a rules
+  table instead of `like`'s current placement — the reshard-on-restore
+  entry point (pod checkpoint → serving mesh, relayout after a
+  topology change)."""
+  return restore_state(
+      model_dir,
+      reshard_like(like, mesh, rules,
+                   min_size_to_shard=min_size_to_shard),
+      step=step)
+
+
 def restore_state(model_dir: str, like: Any,
                   step: Optional[int] = None) -> Any:
   """Restores a full TrainState; arrays adopt `like`'s shardings."""

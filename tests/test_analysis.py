@@ -854,7 +854,7 @@ class TestGinValidation:
     )
     package = os.path.join(REPO_ROOT, "tensor2robot_tpu")
     configs = discover_configs([package])
-    assert len(configs) == 13, configs  # re-pin when shipping new ones
+    assert len(configs) == 14, configs  # re-pin when shipping new ones
     found = run_gin_rules([package], REPO_ROOT)
     assert found == [], [f.render() for f in found]
 
@@ -937,3 +937,95 @@ class TestGinValidation:
         "configs", "train_pose_env.gin")
     validate_config_file(config, REPO_ROOT)
     assert gin.config_str() == ""  # validate-only: no bindings landed
+
+
+class TestShardingRulesCoverage:
+  """GIN108 (ISSUE 12): every sharding rules table matches every
+  param of its model family — unmatched-param and dead-regex
+  findings; the shipped tables stay clean (baseline stays empty)."""
+
+  def test_repo_family_tables_produce_no_findings(self):
+    from tensor2robot_tpu.analysis.gin_check import (
+        run_sharding_rules_checks,
+    )
+    found = run_sharding_rules_checks()
+    assert found == [], [f.render() for f in found]
+
+  def test_unmatched_param_flagged(self):
+    import numpy as np
+    from tensor2robot_tpu.analysis.gin_check import (
+        run_sharding_rules_checks,
+    )
+    from tensor2robot_tpu.parallel import Replicate
+    families = {"fixture": (
+        ((r"/kernel$", Replicate()),),
+        [{"layer": {"kernel": np.zeros((4,)),
+                    "bias": np.zeros((4,))}}])}
+    found = run_sharding_rules_checks(families)
+    assert [f.rule for f in found] == ["GIN108"]
+    assert "layer/bias" in found[0].message
+    assert "matches no sharding rule" in found[0].message
+
+  def test_dead_regex_flagged(self):
+    import numpy as np
+    from tensor2robot_tpu.analysis.gin_check import (
+        run_sharding_rules_checks,
+    )
+    from tensor2robot_tpu.parallel import Replicate, ShardLargest
+    families = {"fixture": (
+        ((r"/stale_name$", ShardLargest()),
+         (r".*", Replicate())),
+        [{"layer": {"kernel": np.zeros((4,))}}])}
+    found = run_sharding_rules_checks(families)
+    assert [f.rule for f in found] == ["GIN108"]
+    assert "stale_name" in found[0].message
+    assert "dead regex" in found[0].message
+
+  def test_final_catchall_default_is_exempt(self):
+    """A fully-covering table keeps its safety-net default without a
+    dead-regex finding — only NON-final dead rules flag."""
+    import numpy as np
+    from tensor2robot_tpu.analysis.gin_check import (
+        run_sharding_rules_checks,
+    )
+    from tensor2robot_tpu.parallel import Replicate, ShardLargest
+    families = {"fixture": (
+        ((r"/kernel$", ShardLargest()),
+         (r".*", Replicate())),
+        [{"layer": {"kernel": np.zeros((4,))}}])}
+    assert run_sharding_rules_checks(families) == []
+
+  def test_broken_template_does_not_blind_other_families(self,
+                                                         monkeypatch):
+    """One family whose template construction fails must report ITS
+    finding and still surface coverage findings for the others."""
+    import numpy as np
+    from tensor2robot_tpu.analysis.gin_check import (
+        run_sharding_rules_checks,
+    )
+    from tensor2robot_tpu.parallel import Replicate, rules as rules_lib
+
+    fake_rules = {"broken": ((r".*", Replicate()),),
+                  "gappy": ((r"/kernel$", Replicate()),)}
+    monkeypatch.setattr(rules_lib, "FAMILY_RULES", fake_rules)
+    monkeypatch.setattr(rules_lib, "family_rules",
+                        lambda name: fake_rules[name])
+
+    def templates(name):
+      if name == "broken":
+        raise ImportError("no such module")
+      return [{"layer": {"kernel": np.zeros((4,)),
+                         "bias": np.zeros((4,))}}]
+
+    monkeypatch.setattr(rules_lib, "family_param_templates", templates)
+    found = run_sharding_rules_checks()
+    assert [f.rule for f in found] == ["GIN108", "GIN108"]
+    assert "template construction failed" in found[0].message
+    assert "layer/bias" in found[1].message  # 'gappy' still checked
+
+  def test_gin_family_runs_the_coverage_check(self, tmp_path):
+    """GIN108 rides `run_gin_rules` — the lint entry point scripts/
+    lint.sh and tier-1 invoke."""
+    from tensor2robot_tpu.analysis.gin_check import run_gin_rules
+    found = run_gin_rules([str(tmp_path)], str(tmp_path))
+    assert [f for f in found if f.rule == "GIN108"] == []

@@ -130,3 +130,101 @@ class TestStageShardedCheckpoints:
     _values_equal(restored_staged, sharded)
     leaf = jax.tree_util.tree_leaves(restored_staged)[0]
     assert leaf.sharding.spec[0] == STAGE_AXIS, leaf.sharding
+
+
+class TestRulesSeamReshardRoundtrip:
+  """ISSUE 12 satellite: the gather/shard-fns reshard contract.
+
+  A checkpoint saved under a 1-DEVICE mesh restores onto the
+  8-virtual-device fsdp mesh via `restore_state_on_mesh` (layout from
+  the rules table, not from `like`'s placement), and a checkpoint
+  saved from THAT sharded state restores back onto the 1-device mesh
+  — params bitwise both ways, gathered through
+  `make_shard_and_gather_fns`' gather fns."""
+
+  def _params(self):
+    rng = np.random.default_rng(3)
+    return {
+        "torso_conv_0": {"kernel": jnp.asarray(
+            rng.standard_normal((3, 3, 3, 64)), jnp.float32)},
+        "q_head": {"dense_0": {
+            "kernel": jnp.asarray(rng.standard_normal((128, 64)),
+                                  jnp.float32),
+            "bias": jnp.asarray(rng.standard_normal((64,)),
+                                jnp.float32)}},
+    }
+
+  def test_one_device_save_restores_onto_fsdp_mesh_and_back(
+      self, tmp_path):
+    from tensor2robot_tpu.parallel import (
+        FSDP_AXIS,
+        ShardLargest,
+        make_shard_and_gather_fns,
+        match_partition_rules,
+    )
+
+    rules = ((r".*", ShardLargest(FSDP_AXIS)),)
+    params = self._params()
+
+    # Save under a 1-device mesh (single-chip trainer shape).
+    mesh_1 = create_mesh({FSDP_AXIS: 1}, devices=jax.devices()[:1])
+    on_one = jax.device_put(
+        params, jax.tree_util.tree_map(
+            lambda s: jax.sharding.NamedSharding(mesh_1, s),
+            match_partition_rules(rules, params, mesh_1,
+                                  min_size_to_shard=64),
+            is_leaf=lambda x: isinstance(
+                x, jax.sharding.PartitionSpec)))
+    _save(tmp_path / "one", on_one)
+
+    # Restore onto the 8-virtual-device fsdp mesh, layout from the
+    # rules table (NOT from `like`, which is host-resident).
+    mesh_8 = create_mesh({FSDP_AXIS: 8})
+    host_like = jax.tree_util.tree_map(np.asarray, params)
+    restored_8 = ckpt_lib.restore_state_on_mesh(
+        str(tmp_path / "one"), like=host_like, mesh=mesh_8,
+        rules=rules, min_size_to_shard=64)
+    kernel = restored_8["torso_conv_0"]["kernel"]
+    assert FSDP_AXIS in [ax for ax in kernel.sharding.spec if ax], (
+        kernel.sharding)
+
+    # Bitwise through the GATHER fns: every leaf gathered from the
+    # 8-way layout equals the saved host values exactly.
+    specs_8 = match_partition_rules(rules, params, mesh_8,
+                                    min_size_to_shard=64)
+    _, gather_fns = make_shard_and_gather_fns(mesh_8, specs_8)
+    gathered = jax.tree_util.tree_map(lambda f, x: f(x), gather_fns,
+                                      restored_8)
+    jax.tree_util.tree_map(np.testing.assert_array_equal, gathered,
+                           host_like)
+
+    # And back: save the 8-way state, restore onto the 1-device mesh.
+    _save(tmp_path / "eight", restored_8)
+    restored_1 = ckpt_lib.restore_state_on_mesh(
+        str(tmp_path / "eight"), like=host_like, mesh=mesh_1,
+        rules=rules, min_size_to_shard=64)
+    _, gather_1 = make_shard_and_gather_fns(
+        mesh_1, match_partition_rules(rules, params, mesh_1,
+                                      min_size_to_shard=64))
+    back = jax.tree_util.tree_map(lambda f, x: f(x), gather_1,
+                                  restored_1)
+    jax.tree_util.tree_map(np.testing.assert_array_equal, back,
+                           host_like)
+
+  def test_family_rules_drive_restore(self, tmp_path):
+    """The gin-facing shape: a family NAME selects the table."""
+    from tensor2robot_tpu.parallel import FSDP_AXIS, family_rules
+
+    params = self._params()
+    _save(tmp_path, jax.tree_util.tree_map(np.asarray, params))
+    mesh = create_mesh({FSDP_AXIS: 8})
+    restored = ckpt_lib.restore_state_on_mesh(
+        str(tmp_path), like=jax.tree_util.tree_map(np.asarray, params),
+        mesh=mesh, rules=family_rules("qtopt"))
+    kernel = restored["torso_conv_0"]["kernel"]
+    # qtopt table: conv kernels ride ShardLargest(fsdp); 1728 > 2**10.
+    assert FSDP_AXIS in [ax for ax in kernel.sharding.spec if ax]
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(jax.device_get(a)), np.asarray(b)),
+        restored, params)

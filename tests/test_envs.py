@@ -716,3 +716,202 @@ class TestTrainAnakinLearning:
                                cem_population=64, cem_iterations=3)
     assert sweep["success_rate"] > max(
         3 * sweep["random_baseline_success_rate"], 0.5), sweep
+
+
+class TestShardMapPodProgram:
+  """The jit+shard_map pod program over the named `pod` mesh axis
+  (ISSUE 12): env shards / rings / Bellman batches ride
+  PartitionSpec("pod"), training runs as GSPMD jit — so ZeRO
+  (`shard_weight_update`) composes with the pod axis instead of being
+  warn-ignored, and D=1 is bitwise the pmap pod program."""
+
+  POD_KWARGS = dict(
+      env_family="pose", num_envs=16, rollout_length=2,
+      train_batches_per_iter=4, batch_size=16, replay_capacity=128,
+      max_train_steps=16, log_every_steps=8,
+      save_checkpoints_steps=16, seed=0)
+
+  def test_smoke_metrics_and_exact_resume(self, tmp_path):
+    learner = _tiny_learner()
+    state = train_anakin(learner=learner, model_dir=str(tmp_path),
+                         num_devices=2, pod_program="shard_map",
+                         **self.POD_KWARGS)
+    assert int(np.asarray(jax.device_get(state.step))) == 16
+    rows = read_records(str(tmp_path / "metrics_train.jsonl"))
+    assert rows
+    for row in rows:
+      # Same contract as the pmap pod program: acting params ARE the
+      # training params inside the one jitted program.
+      assert row["param_refresh_lag_steps"] == 0.0
+      assert row["devices"] == 2
+      assert row["global_batch_size"] == 32
+      assert row["bellman_batches_per_sec"] == pytest.approx(
+          2 * row["grad_steps_per_sec"])
+      assert 0.0 <= row["replay_fill"] <= 1.0
+      assert np.isfinite(row["loss"])
+    resumed = train_anakin(learner=learner, model_dir=str(tmp_path),
+                           num_devices=2, pod_program="shard_map",
+                           **self.POD_KWARGS)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(jax.device_get(a)),
+            np.asarray(jax.device_get(b))),
+        state.train_state.params, resumed.train_state.params)
+
+  def test_zero_shards_moments_across_pod_axis(self, tmp_path,
+                                               caplog):
+    """THE composition pin: shard_weight_update in shard_map pod mode
+    leaves optimizer moments sharded P over the `pod` axis — no
+    warn-ignore path — while params stay replicated."""
+    import logging
+
+    from tensor2robot_tpu.envs.rollout import POD_AXIS
+
+    learner = _tiny_learner(image_size=16)
+    with caplog.at_level(logging.WARNING,
+                         logger="tensor2robot_tpu.envs.rollout"):
+      state = train_anakin(
+          learner=learner, model_dir=str(tmp_path),
+          num_devices=2, pod_program="shard_map",
+          shard_weight_update=True, update_shard_min_size=64,
+          sharding_rules="qtopt", **self.POD_KWARGS)
+    # No warn-ignore: the flag composes instead of being dropped.
+    assert not any("shard_weight_update" in r.message
+                   for r in caplog.records)
+    pod_sharded = [
+        leaf for leaf in jax.tree_util.tree_leaves(
+            state.train_state.opt_state)
+        if hasattr(leaf, "sharding")
+        and POD_AXIS in [ax for ax in leaf.sharding.spec if ax]]
+    assert pod_sharded, "no optimizer moment rides the pod axis"
+    for leaf in jax.tree_util.tree_leaves(state.train_state.params):
+      assert leaf.sharding.spec == jax.sharding.PartitionSpec()
+
+  def test_rejects_unknown_pod_program_and_family(self, tmp_path):
+    learner = _tiny_learner()
+    with pytest.raises(ValueError, match="pod_program"):
+      train_anakin(learner=learner, model_dir=str(tmp_path),
+                   num_devices=2, pod_program="spmd",
+                   **self.POD_KWARGS)
+    with pytest.raises(ValueError, match="unknown model family"):
+      train_anakin(learner=learner, model_dir=str(tmp_path),
+                   num_devices=2, pod_program="shard_map",
+                   sharding_rules="nope", **self.POD_KWARGS)
+
+  def test_two_devices_close_to_pmap_pod(self, tmp_path):
+    """Program-substrate invariance, statistically pinned: the
+    shard_map program at D=2 matches the pmap program's collection
+    volume exactly and lands its Bellman targets in the same regime
+    (global-batch GSPMD training vs per-device pmean'd training are
+    numerically different schedules, not different learners)."""
+    learner = _tiny_learner()
+    pmap_state = train_anakin(
+        learner=learner, model_dir=str(tmp_path / "pmap"),
+        num_devices=2, **self.POD_KWARGS)
+    sm_state = train_anakin(
+        learner=learner, model_dir=str(tmp_path / "sm"),
+        num_devices=2, pod_program="shard_map", **self.POD_KWARGS)
+    rows_p = read_records(str(tmp_path / "pmap" /
+                              "metrics_train.jsonl"))
+    rows_s = read_records(str(tmp_path / "sm" /
+                              "metrics_train.jsonl"))
+    assert int(pmap_state.step) == int(
+        np.asarray(jax.device_get(sm_state.step))) == 16
+    assert rows_s[-1]["replay_fill"] == rows_p[-1]["replay_fill"]
+    assert np.isfinite(rows_s[-1]["loss"])
+    assert abs(rows_s[-1]["target_mean"]
+               - rows_p[-1]["target_mean"]) < 0.25
+
+  @pytest.mark.slow
+  def test_shardmap_one_device_bitwise_vs_pmap_pod(self):
+    """THE equivalence pin (acceptance, ISSUE 12): at D=1 the
+    jit+shard_map pod program reproduces the pmap pod program BITWISE
+    on params/opt_state/batch_stats/target_params — same PRNG
+    schedule, same ring schedule, same updates. Runs in a subprocess
+    under an FMA-less ISA cap (`--xla_cpu_max_isa=SSE4_2`), the PR-10
+    methodology: jit- and pmap-compiled modules of the same jaxpr may
+    differ by per-module FMA-contraction choices, and program
+    equivalence is what remains once that freedom is removed."""
+    import subprocess
+    import sys
+    import textwrap
+
+    script = textwrap.dedent("""
+        import tempfile
+        import numpy as np, jax
+        from tensor2robot_tpu.envs import train_anakin
+        from tensor2robot_tpu.research.qtopt import (
+            GraspingQModel, QTOptLearner)
+
+        def tiny():
+          model = GraspingQModel(image_size=16, torso_filters=(8,),
+                                 head_filters=(8,), dense_sizes=(16,),
+                                 action_dim=2)
+          return QTOptLearner(model, cem_population=8,
+                              cem_iterations=1, cem_elites=2)
+
+        kwargs = dict(env_family="pose", num_envs=16,
+                      rollout_length=2, train_batches_per_iter=4,
+                      batch_size=16, replay_capacity=128,
+                      max_train_steps=16, log_every_steps=8,
+                      save_checkpoints_steps=16, seed=0)
+        with tempfile.TemporaryDirectory() as t1:
+          pmap_pod = train_anakin(learner=tiny(), model_dir=t1,
+                                  num_devices=1, **kwargs)
+        with tempfile.TemporaryDirectory() as t2:
+          sm_pod = train_anakin(learner=tiny(), model_dir=t2,
+                                num_devices=1,
+                                pod_program="shard_map", **kwargs)
+        for tag, a, b in (
+            ("params", pmap_pod.train_state.params,
+             sm_pod.train_state.params),
+            ("batch_stats", pmap_pod.train_state.batch_stats,
+             sm_pod.train_state.batch_stats),
+            ("opt_state", pmap_pod.train_state.opt_state,
+             sm_pod.train_state.opt_state),
+            ("target_params", pmap_pod.target_params,
+             sm_pod.target_params)):
+          la = jax.tree_util.tree_leaves(jax.device_get(a))
+          lb = jax.tree_util.tree_leaves(jax.device_get(b))
+          for x, y in zip(la, lb):
+            assert np.array_equal(np.asarray(x), np.asarray(y)), tag
+        print("BITWISE_OK")
+    """)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=2 "
+                        "--xla_cpu_max_isa=SSE4_2")
+    out = subprocess.run(
+        [sys.executable, "-c", script], env=env, capture_output=True,
+        text=True, timeout=900,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert out.returncode == 0, out.stderr[-4000:]
+    assert "BITWISE_OK" in out.stdout
+
+  def test_zero_rewrap_across_device_counts_does_not_stack(
+      self, tmp_path):
+    """Bench rows reuse ONE learner across device counts: the keyed
+    `wrap_optimizer(key="shard_weight_update")` must REPLACE the
+    previous pod-mesh wrap, not stack a constraint pinned to a dead
+    mesh's devices (the full-bench failure this regression-pins)."""
+    learner = _tiny_learner()
+    kwargs = {**self.POD_KWARGS, "max_train_steps": 8,
+              "log_every_steps": 4, "save_checkpoints_steps": 8}
+    for run, dcount in enumerate((2, 4)):
+      state = train_anakin(
+          learner=learner, model_dir=str(tmp_path / str(run)),
+          num_devices=dcount, pod_program="shard_map",
+          shard_weight_update=True, update_shard_min_size=64,
+          **kwargs)
+      assert int(np.asarray(jax.device_get(state.step))) == 8
+    # And the flag-OFF leak direction: a later run WITHOUT the flag on
+    # the same learner must get the identity re-wrap, not the previous
+    # run's pod-mesh-pinned ZeRO constraint — its moments replicate.
+    state = train_anakin(
+        learner=learner, model_dir=str(tmp_path / "off"),
+        num_devices=2, pod_program="shard_map",
+        shard_weight_update=False, **kwargs)
+    assert int(np.asarray(jax.device_get(state.step))) == 8
+    for leaf in jax.tree_util.tree_leaves(state.train_state.opt_state):
+      if hasattr(leaf, "sharding"):
+        assert leaf.sharding.spec == jax.sharding.PartitionSpec(), leaf

@@ -453,3 +453,78 @@ class TestEnvelopeFromTrainers:
     records = trecords.read_records(
         os.path.join(qtopt_dir, "metrics_train.jsonl"))
     assert "compile_cache.requests" in records[-1]
+
+
+class TestPrometheusAdapter:
+  """The Prometheus text-format endpoint (ISSUE 12 satellite): a
+  ~50-line adapter over `MetricsRegistry.snapshot()` — counters as
+  `_total`, gauges verbatim, histograms as CUMULATIVE `le` buckets
+  closed by `+Inf`, names sanitized to the exposition charset."""
+
+  def _publish(self):
+    tmetrics.counter("replay.add_rows").inc(7)
+    tmetrics.gauge("serving.queue_depth").set(3.0)
+    hist = tmetrics.histogram("serving.bucket_8_ms",
+                              bounds=(1.0, 10.0))
+    hist.observe(0.5)
+    hist.observe(5.0)
+    hist.observe(50.0)
+
+  def test_render_scrape_format(self):
+    from tensor2robot_tpu.telemetry import prometheus
+
+    self._publish()
+    body = prometheus.render_text()
+    lines = body.splitlines()
+    # Counters: sanitized (dots → underscores), `_total`-suffixed.
+    assert "# TYPE t2r_replay_add_rows_total counter" in lines
+    assert "t2r_replay_add_rows_total 7.0" in lines
+    assert "# TYPE t2r_serving_queue_depth gauge" in lines
+    assert "t2r_serving_queue_depth 3.0" in lines
+    # Histogram: cumulative buckets, +Inf closes at total count.
+    assert "# TYPE t2r_serving_bucket_8_ms histogram" in lines
+    assert 't2r_serving_bucket_8_ms_bucket{le="1.0"} 1' in lines
+    assert 't2r_serving_bucket_8_ms_bucket{le="10.0"} 2' in lines
+    assert 't2r_serving_bucket_8_ms_bucket{le="+Inf"} 3' in lines
+    assert "t2r_serving_bucket_8_ms_sum 55.5" in lines
+    assert "t2r_serving_bucket_8_ms_count 3" in lines
+    assert body.endswith("\n")
+
+  def test_metric_names_sanitize_to_exposition_charset(self):
+    import re
+
+    from tensor2robot_tpu.telemetry import prometheus
+
+    tmetrics.counter("fleet.actor-0.steps").inc()
+    body = prometheus.render_text()
+    name_re = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{|\s)")
+    for line in body.splitlines():
+      if line.startswith("#"):
+        continue
+      assert name_re.match(line), line
+    assert "t2r_fleet_actor_0_steps_total 1.0" in body
+
+  def test_http_endpoint_scrapes_live_registry(self):
+    import urllib.request
+
+    from tensor2robot_tpu.telemetry import prometheus
+
+    self._publish()
+    endpoint = prometheus.serve(port=0)
+    try:
+      url = f"http://127.0.0.1:{endpoint.port}/metrics"
+      with urllib.request.urlopen(url, timeout=5) as resp:
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        body = resp.read().decode("utf-8")
+      assert "t2r_replay_add_rows_total 7.0" in body
+      # Scrape-time snapshot: a later publish shows on the NEXT pull.
+      tmetrics.counter("replay.add_rows").inc(1)
+      with urllib.request.urlopen(url, timeout=5) as resp:
+        assert "t2r_replay_add_rows_total 8.0" in resp.read().decode(
+            "utf-8")
+      with pytest.raises(urllib.error.HTTPError):
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{endpoint.port}/other", timeout=5)
+    finally:
+      endpoint.close()
