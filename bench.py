@@ -2220,6 +2220,389 @@ def bench_serving(dry_run: bool = False):
   return detail
 
 
+def bench_serving_front(dry_run: bool = False):
+  """The multi-tenant serving axis: OPEN-LOOP goodput, not latency.
+
+  Closed-loop benches (the `serving_latency` section) measure what one
+  caller sees; a service's question is what happens when load keeps
+  ARRIVING whether or not the system keeps up. This section drives the
+  `ServingFront` (continuous batching across tenants over a
+  `ModelArena` of pinned-param engines, admission-gated per tenant)
+  with Poisson arrivals and measures:
+
+    * p50/p95/p99 end-to-end latency + GOODPUT (completions inside the
+      SLO per second) vs offered load — the open-loop curve closed
+      benches cannot see (queueing delay compounds past saturation);
+    * goodput vs TENANT COUNT at fixed total offered load (the
+      multiplexing bill: more models per device = more dispatch
+      interleave, same arrivals);
+    * an OVERLOAD leg: one abusive tenant offered far above its
+      token-bucket rate next to in-SLO tenants — admission must shed
+      the abuser (drop counters visible in the telemetry registry)
+      while the in-SLO tenants keep their p99;
+    * an ARENA EVICTION leg: more tenants than the param budget holds,
+      round-robin traffic forcing evict→reload cycles — every reload
+      must be compile-cache-warm (`cache_misses == 0`, HARD GATE: the
+      bench fails rather than commit a cold-reload number).
+
+  The tenant model is the tiny CEM policy config (the serving smoke's
+  model): the contracts under load are scheduling, admission, and
+  residency — request-level behavior, not network math, so a small
+  program keeps the arrival rates high enough to stress the queues on
+  CPU. SLO and offered loads CALIBRATE from this host's measured
+  closed-loop latency, so the sweep lands in the interesting regime on
+  any backend.
+  """
+  import random as _random
+  import shutil
+  import tempfile
+  import threading
+
+  from tensor2robot_tpu.research.qtopt import (
+      GraspingQModel,
+      QTOptLearner,
+  )
+  from tensor2robot_tpu.serving import (
+      AdmissionController,
+      ModelArena,
+      RequestRejected,
+      ServingFront,
+      TenantPolicy,
+  )
+  from tensor2robot_tpu.specs import make_random_tensors
+  from tensor2robot_tpu.startup import compile_cache
+  from tensor2robot_tpu.telemetry import metrics as tmetrics
+
+  max_batch = 2 if dry_run else 8
+  point_secs = 1.0 if dry_run else 6.0
+
+  def make_tenant_loader(seed):
+    # Distinct seeds = distinct checkpoint versions of the same
+    # architecture; the persistent cache serves every tenant's buckets
+    # from one compile (cache keys are value-free avals).
+    def loader():
+      model = GraspingQModel(image_size=16, torso_filters=(8,),
+                             head_filters=(8,), dense_sizes=(16,),
+                             action_dim=2, device_dtype=jnp.float32)
+      learner = QTOptLearner(model, cem_population=8,
+                             cem_iterations=1, cem_elites=2)
+      state = learner.create_state(jax.random.PRNGKey(seed),
+                                   batch_size=2)
+      policy = learner.build_policy()
+      example = make_random_tensors(
+          learner.observation_specification(), batch_size=1, seed=0)
+      return policy, state.train_state, example
+    return loader
+
+  def obs_batch(rows, seed):
+    model = GraspingQModel(image_size=16, torso_filters=(8,),
+                           head_filters=(8,), dense_sizes=(16,),
+                           action_dim=2, device_dtype=jnp.float32)
+    learner = QTOptLearner(model, cem_population=8, cem_iterations=1,
+                           cem_elites=2)
+    return make_random_tensors(learner.observation_specification(),
+                               batch_size=rows, seed=seed)
+
+  obs1 = obs_batch(1, 1)
+
+  def new_front(tenants, cache_dir, budget_bytes=None,
+                policies=None):
+    arena = ModelArena(budget_bytes=budget_bytes, cache_dir=cache_dir)
+    front = ServingFront(arena, AdmissionController(slo_ms=1e9))
+    for tenant in tenants:
+      policy = (policies or {}).get(tenant)
+      seed = sum(ord(c) for c in tenant) % 1000  # stable across runs
+      front.register_tenant(
+          tenant, make_tenant_loader(seed),
+          policy=policy, max_batch=max_batch, takes_rng=True,
+          preload=True)
+    return front
+
+  def run_open_loop(front, rates, duration, seed=0):
+    """Poisson arrivals per tenant at `rates[tenant]` req/s for
+    `duration` seconds; open loop — arrivals never wait for
+    completions. Returns per-tenant offered/shed/latency stats."""
+    stats = {t: {"offered": 0, "shed": 0, "errors": 0,
+                 "latencies": []}
+             for t in rates}
+    lock = threading.Lock()
+    threads = []
+
+    def tenant_load(tenant, rate, thread_seed):
+      rng = _random.Random(thread_seed)
+      entry = stats[tenant]
+      start = time.perf_counter()
+      next_t = start + rng.expovariate(rate)
+      while next_t < start + duration:
+        now = time.perf_counter()
+        if next_t > now:
+          time.sleep(next_t - now)
+        t_submit = time.perf_counter()
+        with lock:
+          entry["offered"] += 1
+        try:
+          future = front.submit(tenant, obs1)
+        except RequestRejected:
+          with lock:
+            entry["shed"] += 1
+        else:
+          def _done(_fut, t0=t_submit, e=entry):
+            # A failed/cancelled future is NOT a completion — scoring
+            # it would overstate goodput exactly when dispatches err.
+            if _fut.cancelled() or _fut.exception() is not None:
+              with lock:
+                e["errors"] += 1
+              return
+            latency = (time.perf_counter() - t0) * 1e3
+            with lock:
+              e["latencies"].append(latency)
+          future.add_done_callback(_done)
+        next_t += rng.expovariate(rate)
+
+    for index, (tenant, rate) in enumerate(sorted(rates.items())):
+      thread = threading.Thread(
+          target=tenant_load, args=(tenant, rate, seed + index))
+      threads.append(thread)
+    t0 = time.perf_counter()
+    for thread in threads:
+      thread.start()
+    for thread in threads:
+      thread.join()
+    # Let in-flight requests complete (bounded: queues are bounded).
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+      with lock:
+        drained = all(
+            len(s["latencies"]) + s["shed"] + s["errors"]
+            >= s["offered"]
+            for s in stats.values())
+      if drained:
+        break
+      time.sleep(0.01)
+    wall = time.perf_counter() - t0
+    with lock:
+      return {t: dict(s) for t, s in stats.items()}, wall
+
+  def summarize(stats, wall, slo_ms, duration):
+    # Two denominators, deliberately different: arrivals stop at
+    # `duration` (the Poisson window), so offered_rps divides by it;
+    # completions keep landing through the drain, so completed/goodput
+    # divide by the full `wall` (window + drain) — CONSERVATIVE at
+    # saturation, where crediting drain-time completions to the window
+    # would overstate the sustained service rate.
+    latencies = np.concatenate(
+        [np.asarray(s["latencies"], np.float64)
+         for s in stats.values() if s["latencies"]]
+        or [np.zeros(0)])
+    offered = sum(s["offered"] for s in stats.values())
+    shed = sum(s["shed"] for s in stats.values())
+    errors = sum(s["errors"] for s in stats.values())
+    completed = int(latencies.size)
+    good = int((latencies <= slo_ms).sum()) if completed else 0
+    out = {
+        "offered_rps": round(offered / duration, 1),
+        "completed_rps": round(completed / wall, 1),
+        "goodput_rps": round(good / wall, 1),
+        "shed": shed,
+        "errors": errors,
+        "in_slo_fraction": round(good / completed, 4) if completed
+        else 0.0,
+    }
+    if completed:
+      for q, key in ((50, "p50_ms"), (95, "p95_ms"), (99, "p99_ms")):
+        out[key] = round(float(np.percentile(latencies, q)), 2)
+    return out
+
+  work = tempfile.mkdtemp(prefix="t2r_front_bench_")
+  cache_dir = os.path.join(work, "xla_cache")
+  detail = {
+      "config": (f"multi-tenant front over tiny CEM tenants "
+                 f"(population=8, iterations=1), bucketed engines "
+                 f"max_batch={max_batch}, continuous batching "
+                 "(max_wait_us=0), open-loop Poisson arrivals"),
+      "device_kind": jax.devices()[0].device_kind,
+      "methodology": (
+          "open loop: arrivals are scheduled by a Poisson clock and "
+          "never wait for completions; latency is submit→future-done "
+          "(queueing included); goodput = completions within SLO per "
+          "second; SLO and offered loads calibrate from this host's "
+          "measured closed-loop p50"),
+  }
+
+  try:
+    # ---- calibration: closed-loop single-request latency ----
+    front = new_front(["cal"], cache_dir)
+    for _ in range(3):
+      front.predict("cal", obs1)
+    samples = []
+    for _ in range(5 if dry_run else 30):
+      t0 = time.perf_counter()
+      front.predict("cal", obs1)
+      samples.append((time.perf_counter() - t0) * 1e3)
+    front.close()
+    p50_1 = float(np.percentile(samples, 50))
+    seq_rps = 1e3 / p50_1
+    slo_ms = max(20.0, 5.0 * p50_1)
+    detail["calibration"] = {
+        "closed_loop_p50_ms": round(p50_1, 2),
+        "sequential_rps": round(seq_rps, 1),
+        "slo_ms": round(slo_ms, 1),
+    }
+
+    # ---- (a) goodput vs offered load (2 tenants, fair split) ----
+    fractions = (0.5,) if dry_run else (0.3, 0.6, 1.0, 1.5, 2.5)
+    sweep = []
+    for fraction in fractions:
+      tenants = [f"ld{int(fraction * 100)}a",
+                 f"ld{int(fraction * 100)}b"]
+      front = new_front(tenants, cache_dir)
+      rate = fraction * seq_rps / len(tenants)
+      stats, wall = run_open_loop(
+          front, {t: rate for t in tenants}, point_secs)
+      point = summarize(stats, wall, slo_ms, point_secs)
+      point["offered_fraction_of_sequential"] = fraction
+      point["dispatches"] = front.dispatches
+      requests = sum(len(s["latencies"]) for s in stats.values())
+      point["mean_rows_per_dispatch"] = round(
+          requests / max(front.dispatches, 1), 2)
+      front.close()
+      sweep.append(point)
+    detail["open_loop_vs_offered_load"] = sweep
+
+    # ---- (b) goodput vs tenant count (fixed total offered) ----
+    counts = (1, 2) if dry_run else (1, 2, 4)
+    tenant_rows = []
+    for count in counts:
+      tenants = [f"tc{count}_{i}" for i in range(count)]
+      front = new_front(tenants, cache_dir)
+      total = 0.6 * seq_rps
+      stats, wall = run_open_loop(
+          front, {t: total / count for t in tenants}, point_secs)
+      point = summarize(stats, wall, slo_ms, point_secs)
+      point["tenants"] = count
+      completions = [len(s["latencies"]) for s in stats.values()]
+      point["fairness_min_max_completions"] = (
+          round(min(completions) / max(max(completions), 1), 3))
+      front.close()
+      tenant_rows.append(point)
+    detail["open_loop_vs_tenant_count"] = tenant_rows
+
+    # ---- (c) overload: shed the abuser, hold the others' p99 ----
+    good_rate = 0.25 * seq_rps
+    abusive_cap = max(2.0, 0.1 * seq_rps)
+    abusive_burst = max(max_batch, int(abusive_cap / 4))
+    # The offered rate must overwhelm what the token bucket can
+    # possibly serve in the window REGARDLESS of Poisson variance: on
+    # a slow host (tiny seq_rps, short dry-run window) a bare 5×
+    # multiplier can draw fewer arrivals than burst+refill and shed
+    # nothing, SystemExit-failing a perfectly healthy tier-1 smoke.
+    # Mean arrivals ≥ 3×servable+20 puts P(no shed) below ~1e-10.
+    servable = abusive_burst + abusive_cap * point_secs
+    abusive_offered = max(5.0 * abusive_cap,
+                          (3.0 * servable + 20.0) / point_secs)
+    policies = {
+        "ovl_bad": TenantPolicy(
+            rate_rps=abusive_cap, burst=abusive_burst,
+            max_queue=64, overflow="drop", slo_ms=slo_ms),
+    }
+    tenants = ["ovl_a", "ovl_b", "ovl_bad"]
+    front = new_front(tenants, cache_dir, policies=policies)
+    stats, wall = run_open_loop(
+        front,
+        {"ovl_a": good_rate, "ovl_b": good_rate,
+         "ovl_bad": abusive_offered},
+        point_secs)
+    snap = tmetrics.registry().snapshot()
+    overload = {
+        "slo_ms": round(slo_ms, 1),
+        "abusive_rate_cap_rps": round(abusive_cap, 1),
+        "abusive_offered_rps": round(abusive_offered, 1),
+        "abusive": summarize({"x": stats["ovl_bad"]}, wall, slo_ms,
+                              point_secs),
+        "in_slo_tenants": {
+            t: summarize({"x": stats[t]}, wall, slo_ms, point_secs)
+            for t in ("ovl_a", "ovl_b")
+        },
+        "telemetry_drop_counters": {
+            name: value
+            for name, value in snap["counters"].items()
+            if name.startswith("serving.ovl_") and "admission" in name
+        },
+    }
+    overload["abusive_shed_fraction"] = round(
+        stats["ovl_bad"]["shed"]
+        / max(stats["ovl_bad"]["offered"], 1), 3)
+    overload["in_slo_tenants_held_p99"] = all(
+        row.get("p99_ms", float("inf")) <= slo_ms
+        for row in overload["in_slo_tenants"].values())
+    front.close()
+    detail["overload"] = overload
+    if overload["abusive_shed_fraction"] <= 0:
+      raise SystemExit(
+          "serving front bench: the abusive tenant shed nothing — "
+          "admission control is not engaging; refusing to commit.")
+
+    # ---- (d) arena eviction → compile-cache-warm reload ----
+    evict_tenants = (["ev_a", "ev_b", "ev_c"] if not dry_run
+                     else ["ev_a", "ev_b"])
+    probe = new_front(["probe"], cache_dir)
+    tenant_bytes = probe.arena.engine("probe").state_bytes
+    probe.close()
+    resident_target = len(evict_tenants) - 1
+    budget = resident_target * tenant_bytes + tenant_bytes // 2
+    front = new_front(evict_tenants, cache_dir,
+                      budget_bytes=int(budget))
+    rounds = 2 if dry_run else 4
+    for _ in range(rounds):
+      for tenant in evict_tenants:
+        front.predict(tenant, obs1)
+    arena_stats = front.arena.stats()
+    front.close()
+    detail["arena_eviction"] = {
+        "tenants": len(evict_tenants),
+        "budget_bytes": int(budget),
+        "tenant_state_bytes": int(tenant_bytes),
+        "resident_capacity": resident_target,
+        "loads": arena_stats["loads"],
+        "reloads": arena_stats["reloads"],
+        "evictions": arena_stats["evictions"],
+        "reload_cache_misses": arena_stats["reload_cache_misses"],
+        "last_reload_seconds": (arena_stats["last_load"] or {}).get(
+            "seconds"),
+    }
+    if arena_stats["reloads"] < 1:
+      raise SystemExit(
+          "serving front bench: the eviction leg produced no reloads "
+          "— budget math is wrong; refusing to commit.")
+    if arena_stats["reload_cache_misses"] != 0:
+      raise SystemExit(
+          "serving front bench: an evicted tenant's reload RECOMPILED "
+          f"({arena_stats['reload_cache_misses']} cache misses) — the "
+          "compile-cache-warm reload contract is broken; refusing to "
+          "commit.")
+
+    full = next(
+        (row for row in sweep
+         if row["offered_fraction_of_sequential"] >= 1.0), sweep[-1])
+    detail["conclusion"] = (
+        f"open-loop at {full['offered_rps']:.0f} req/s offered "
+        f"(≥ the closed-loop sequential rate): goodput "
+        f"{full['goodput_rps']:.0f}/s at p99 "
+        f"{full.get('p99_ms', 0):.0f} ms (SLO {slo_ms:.0f} ms) — "
+        "continuous batching holds the device saturated past the "
+        "point a per-caller loop would stall; under overload "
+        "admission sheds the over-limit tenant "
+        f"({overload['abusive_shed_fraction']:.0%} of its arrivals) "
+        "while in-SLO tenants "
+        f"{'hold' if overload['in_slo_tenants_held_p99'] else 'LOSE'} "
+        "their p99, and every arena eviction reloads with 0 XLA "
+        "recompiles (persistent compile cache).")
+    return detail
+  finally:
+    compile_cache.reset_compilation_cache_config()
+    shutil.rmtree(work, ignore_errors=True)
+
+
 def _bench_savedmodel_host_latency(calls: int = 100):
   """serving_default latency of the exported policy net on host CPU.
 
@@ -2555,14 +2938,27 @@ def main():
   if "--serving" in args and "--dry-run" in args:
     # Tier-1 smoke of the serving bench path: tiny model, one small
     # bucket table, local backend, NO detail-file write (a CPU smoke
-    # must never clobber the committed chip sections).
+    # must never clobber the committed chip sections). The
+    # multi-tenant front leg rides the same smoke (ISSUE 13): a tiny
+    # open-loop point, the overload shed check, and the
+    # eviction→warm-reload gate (`cache_misses == 0`) all run — the
+    # front bench HARD-FAILS the smoke if admission never sheds or a
+    # reload recompiles.
     smoke = bench_serving(dry_run=True)
+    front_smoke = bench_serving_front(dry_run=True)
     print(json.dumps({
         "serving_dry_run": "ok",
         "device_kind": smoke["device_kind"],
         "batch_1_p50_ms": smoke["batch_1"]["p50_ms"],
         "recompiles_during_timed_phases":
             smoke["recompiles_during_timed_phases"],
+        "front_goodput_rps":
+            front_smoke["open_loop_vs_offered_load"][0]["goodput_rps"],
+        "front_abusive_shed_fraction":
+            front_smoke["overload"]["abusive_shed_fraction"],
+        "front_reloads": front_smoke["arena_eviction"]["reloads"],
+        "front_reload_cache_misses":
+            front_smoke["arena_eviction"]["reload_cache_misses"],
     }))
     return
   profile_dir = None
@@ -2692,6 +3088,11 @@ def main():
     detail["hardware_numerics"] = bench_verify_numerics()
   if "--serving" in args:
     detail["serving_latency"] = bench_serving()
+    # The multi-tenant front: open-loop goodput vs offered load /
+    # tenant count, the overload shed proof, and the eviction→warm-
+    # reload gate (ISSUE 13; ordered after the closed-loop leg so the
+    # front's throwaway compile cache never shadows it).
+    detail["serving_multitenant"] = bench_serving_front()
   if "--fleet" in args:
     detail["fleet"] = bench_fleet()
   if "--envs" in args:

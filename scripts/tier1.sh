@@ -19,7 +19,13 @@ if [ "$lint_rc" -ne 0 ]; then exit "$lint_rc"; fi
 
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 
-echo "--- serving bench smoke (bench.py --serving --dry-run) ---"
+# The serving smoke carries the ISSUE-13 multi-tenant front leg next
+# to the classic closed-loop one: a tiny open-loop (Poisson) point
+# through the ServingFront, the overload check (admission MUST shed
+# the over-limit tenant or the smoke fails), and the arena
+# eviction→reload gate (a reload that RECOMPILES — cache_misses != 0
+# — fails the smoke).
+echo "--- serving bench smoke (bench.py --serving --dry-run; front/open-loop leg) ---"
 env JAX_PLATFORMS=cpu python bench.py --serving --dry-run
 smoke_rc=$?
 

@@ -74,6 +74,7 @@ _DEFAULT_MODULES = (
     "tensor2robot_tpu.meta_learning",
     "tensor2robot_tpu.fleet",
     "tensor2robot_tpu.envs",
+    "tensor2robot_tpu.serving",
     "tensor2robot_tpu.research.grasp2vec",
     "tensor2robot_tpu.research.pose_env",
     "tensor2robot_tpu.research.qtopt",

@@ -19,6 +19,20 @@ into a serving engine:
     idiom), with graceful single-request fallback.
   * `cem_policy.CEMPolicyServer` — the QT-Opt action-selection entry:
     batched on-device CEM behind the engine + micro-batcher.
+
+The MULTI-TENANT front (docs/SERVING.md "Multi-tenant front") stacks
+three more layers over the same engine:
+
+  * `arena.ModelArena` — many models over one device: a budgeted
+    pinned-param pool with LRU eviction and compile-cache-warm
+    reloads (`cache_misses == 0` on an evicted tenant's reload).
+  * `admission.AdmissionController` — per-tenant token-bucket rate +
+    bounded queues with the replay service's overflow contract
+    ("drop" counted / "block" with deadline), and SLO scorecards read
+    off the `serving.<tenant>.bucket_<n>_ms` histograms.
+  * `front.ServingFront` — ONE continuous-batching dispatcher over
+    every tenant's queue with round-robin fair share, replacing
+    per-model micro-batcher loops.
 """
 
 from tensor2robot_tpu.serving.bucketing import (
@@ -30,3 +44,10 @@ from tensor2robot_tpu.serving.bucketing import (
 from tensor2robot_tpu.serving.engine import BucketedServingEngine
 from tensor2robot_tpu.serving.microbatcher import MicroBatcher
 from tensor2robot_tpu.serving.cem_policy import CEMPolicyServer
+from tensor2robot_tpu.serving.admission import (
+    AdmissionController,
+    RequestRejected,
+    TenantPolicy,
+)
+from tensor2robot_tpu.serving.arena import ModelArena
+from tensor2robot_tpu.serving.front import ServingFront

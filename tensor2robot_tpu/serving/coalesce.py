@@ -1,0 +1,130 @@
+"""Shared request-coalescing machinery: micro-batcher ∩ serving front.
+
+Both dispatchers speak the same request protocol — objects with
+``features`` (a pytree with a leading batch dim), ``n`` (rows), and
+``future`` (a `concurrent.futures.Future`) — and share four steps
+whose contracts must never drift between the single-model and
+multi-tenant paths (the reason this module exists, once):
+
+  * `take_batch` — first request (carry leads: a request that
+    overflowed the previous dispatch heads this one, so FIFO-re-put
+    line-jumping can't starve it) plus whatever coalesces within the
+    deadline, ≤ max_batch rows;
+  * `claim_batch` — marks every taken request RUNNING via
+    ``set_running_or_notify_cancel()``. A future cancelled while
+    queued is DROPPED here (its caller already sees CancelledError);
+    after a successful claim ``cancel()`` can no longer win, so
+    result delivery can never hit `InvalidStateError` — one poisoned
+    future must never cost its co-batched neighbors their results;
+  * `concat_features` / `deliver` — one concatenated dispatch in,
+    per-caller slices out, each ``.copy()``-ed so a caller's in-place
+    post-processing cannot corrupt its co-batched neighbors' rows;
+  * `fail_batch` — an error inside the dispatch reaches every
+    still-pending caller instead of hanging them.
+"""
+
+from __future__ import annotations
+
+import queue
+import time
+from concurrent import futures
+from typing import Any, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+
+def take_batch(source: "queue.Queue",
+               carry,
+               max_batch: int,
+               max_wait_secs: float,
+               first_timeout_secs: Optional[float] = None
+               ) -> Tuple[List[Any], Any]:
+  """Coalesces one dispatch's requests; returns ``(batch, carry')``.
+
+  The first request comes from ``carry`` (it leads, see module doc) or
+  from the queue — blocking up to ``first_timeout_secs`` (None =
+  non-blocking; the front's continuous loop has its own wakeup
+  channel, the micro-batcher parks here). Further requests coalesce
+  until ``max_batch`` rows or the ``max_wait_secs`` deadline; with a
+  zero deadline, already-queued requests still coalesce but nothing is
+  held waiting for arrivals. A request that would overflow becomes the
+  new carry.
+  """
+  if carry is not None:
+    first, carry = carry, None
+  else:
+    try:
+      first = (source.get(timeout=first_timeout_secs)
+               if first_timeout_secs else source.get_nowait())
+    except queue.Empty:
+      return [], None
+  batch = [first]
+  rows = first.n
+  deadline = time.perf_counter() + max_wait_secs
+  while rows < max_batch:
+    remaining = deadline - time.perf_counter()
+    try:
+      nxt = (source.get(timeout=remaining) if remaining > 0
+             else source.get_nowait())
+    except queue.Empty:
+      break
+    if rows + nxt.n > max_batch:
+      carry = nxt
+      break
+    batch.append(nxt)
+    rows += nxt.n
+  return batch, carry
+
+
+def claim_batch(batch: List[Any]) -> List[Any]:
+  """RUNNING-marks the batch; returns the requests still live.
+
+  Dropped entries were cancelled while queued (their callers hold a
+  CANCELLED future) or already FINISHED by a racing ``close()``'s
+  stranded-request drain — ``set_running_or_notify_cancel`` raises
+  `InvalidStateError` on those, which must not kill the dispatcher
+  mid-batch and strand the neighbors. Everything returned is
+  un-cancellable and un-finished, so `deliver` cannot race.
+  """
+  claimed = []
+  for request in batch:
+    try:
+      if request.future.set_running_or_notify_cancel():
+        claimed.append(request)
+    except (futures.InvalidStateError, RuntimeError):
+      # Stdlib raises bare RuntimeError for a FINISHED future here
+      # (InvalidStateError is only its set_result/set_exception
+      # sibling): a racing close() already failed it; not ours.
+      pass
+  return claimed
+
+
+def concat_features(batch: List[Any]) -> Any:
+  """One dispatch-ready features tree from the batch's requests."""
+  return jax.tree_util.tree_map(
+      lambda *leaves: np.concatenate(
+          [np.asarray(a) for a in leaves], axis=0),
+      *[request.features for request in batch])
+
+
+def deliver(batch: List[Any], outputs: Any) -> None:
+  """Scatters per-caller slices of ``outputs`` back to the futures.
+
+  Requires a CLAIMED batch (`claim_batch`): every future is RUNNING,
+  so ``set_result`` cannot raise. Slices are copied — callers own
+  their rows.
+  """
+  offset = 0
+  for request in batch:
+    lo, hi = offset, offset + request.n
+    request.future.set_result(jax.tree_util.tree_map(
+        lambda a: a[lo:hi].copy(), outputs))
+    offset = hi
+
+
+def fail_batch(batch: List[Any], exc: BaseException) -> None:
+  """Delivers ``exc`` to every caller still waiting."""
+  for request in batch:
+    if not request.future.done():
+      request.future.set_exception(exc)

@@ -70,7 +70,8 @@ class BucketedServingEngine:
                example_features: Any,
                max_batch: int = 8,
                takes_rng: bool = False,
-               donate_features: bool = True):
+               donate_features: bool = True,
+               metric_prefix: str = "serving."):
     """Args:
       fn: pure `(state, features)` or `(state, features, rng)` callable.
       state: the params pytree `fn` closes over per call; transferred
@@ -82,6 +83,11 @@ class BucketedServingEngine:
       takes_rng: whether `fn` threads a PRNG key (CEM policies).
       donate_features: donate the padded request buffers into the
         program.
+      metric_prefix: namespace for this engine's registry metrics.
+        The multi-tenant arena passes ``serving.<tenant>.`` so every
+        tenant gets its own ``serving.<tenant>.bucket_<n>_ms``
+        histograms (the SLO-accounting seam, docs/SERVING.md) and the
+        Prometheus adapter renders the tenant as a label.
     """
     from tensor2robot_tpu.startup import compile_cache
     compile_cache.configure_compilation_cache()
@@ -95,6 +101,12 @@ class BucketedServingEngine:
     placed = jax.device_put(state)
     jax.block_until_ready(placed)
     self._state = placed
+    # Device bytes this engine pins (the arena's budget unit): params
+    # only — compiled executables multiply code, never this.
+    self._state_bytes = sum(
+        leaf.nbytes for leaf in jax.tree_util.tree_leaves(placed)
+        if isinstance(leaf, jax.Array))
+    self._released = False
     # The versioned publication record; `_state` is kept in sync for
     # introspection, but the hot path and the version/learner-step
     # readers all go through this one reference.
@@ -128,8 +140,9 @@ class BucketedServingEngine:
     self.swap_count = 0
     # Telemetry handles cached per engine (per-bucket lazily): the
     # hot path calls .observe()/.inc() without a registry lookup.
-    self._tm_dispatches = tmetrics.counter("serving.dispatches")
-    self._tm_swaps = tmetrics.counter("serving.swaps")
+    self._metric_prefix = metric_prefix
+    self._tm_dispatches = tmetrics.counter(f"{metric_prefix}dispatches")
+    self._tm_swaps = tmetrics.counter(f"{metric_prefix}swaps")
     self._tm_bucket_ms: Dict[int, Any] = {}
 
   @property
@@ -144,6 +157,51 @@ class BucketedServingEngine:
   def compiled_buckets(self):
     return tuple(sorted(self._compiled))
 
+  @property
+  def state_bytes(self) -> int:
+    """Device bytes the pinned params tree occupies (arena budgeting).
+
+    Constant for the engine's lifetime: swaps keep shapes/dtypes."""
+    return self._state_bytes
+
+  @property
+  def released(self) -> bool:
+    return self._released
+
+  def release(self) -> None:
+    """Retires the engine and drops its pinned device buffers
+    (arena eviction path).
+
+    Drops the engine's REFERENCES to the params tree and the
+    compiled-executable table rather than hard-deleting the buffers:
+    a dispatch already in flight on another thread holds its own
+    reference to the published state and completes safely on the old
+    params — the buffers free the moment the last reference dies
+    (refcounting; in-flight dispatches are milliseconds, so the
+    memory deadline is effectively the release). New `predict` calls
+    fail fast with a clear error. A reload builds a FRESH engine;
+    with the persistent compile cache configured its bucket compiles
+    deserialize instead of recompiling (`cache_misses == 0`, the
+    arena's reload contract). Idempotent.
+    """
+    # Under BOTH coordination locks (swap first, then compile — the
+    # one place they nest, so no ordering cycle): _compile_bucket
+    # checks the released flag under the compile lock (no cold-compile
+    # resurrection into the cleared table, no lowering against None
+    # avals), and swap_state re-checks it under the swap lock (a swap
+    # losing the race to an eviction must not re-pin params into the
+    # retired engine). Dict clears and reference drops only — nothing
+    # blocking runs under either lock here.
+    with self._swap_lock:
+      with self._compile_lock:
+        if self._released:
+          return
+        self._released = True
+        self._compiled.clear()
+        self._published = _Published(None, version=-1, learner_step=-1)
+        self._state = None
+        self._state_avals = None
+
   # ---- compilation ----
 
   def _feature_avals(self, bucket: int):
@@ -151,13 +209,23 @@ class BucketedServingEngine:
         lambda sd: jax.ShapeDtypeStruct((bucket,) + sd.shape, sd.dtype),
         self._row_avals)
 
-  def _compile_bucket(self, bucket: int) -> None:
+  def _compile_bucket(self, bucket: int):
+    """Compiles (or finds) the bucket's executable and RETURNS it —
+    callers must dispatch the returned handle, not re-read the table:
+    a release() racing in clears the table, and the local handle is
+    what keeps the dispatch safe."""
     global _COMPILE_COUNT
     import warnings
 
     with self._compile_lock:
+      if self._released:
+        # A dispatch racing a release must not resurrect the engine by
+        # cold-compiling into the cleared table.
+        raise RuntimeError(
+            "BucketedServingEngine was released (arena eviction); "
+            "reload the tenant through the arena instead.")
       if bucket in self._compiled:
-        return  # lost a benign race to the warmup thread
+        return self._compiled[bucket]  # benign race to the warmup thread
       args = [self._state_avals, self._feature_avals(bucket)]
       if self._takes_rng:
         args.append(jax.ShapeDtypeStruct((2,), np.uint32))
@@ -171,8 +239,10 @@ class BucketedServingEngine:
         # serializes an async warmup against a cold predict so the
         # same bucket never compiles twice; only compilers contend.
         # t2rcheck: disable=CON301
-        self._compiled[bucket] = self._jitted.lower(*args).compile()
+        executable = self._jitted.lower(*args).compile()
+        self._compiled[bucket] = executable
       _COMPILE_COUNT += 1
+      return executable
 
   def warmup(self) -> float:
     """AOT-compiles every bucket; returns wall seconds spent.
@@ -260,7 +330,22 @@ class BucketedServingEngine:
     publisher's training progress (kept from the previous publication
     when omitted, so non-learner swappers don't reset the lag clock).
     """
+    if self._released:
+      raise RuntimeError(
+          "BucketedServingEngine was released (arena eviction); "
+          "swap through the arena, which reloads evicted tenants "
+          "from their loader instead.")
     with self._swap_lock:
+      # Re-check under the lock release() also takes: a swap that
+      # lost the race to an eviction must not re-pin a fresh params
+      # tree into the retired engine (a transient over-budget window
+      # on a tight arena) — it fails here and the arena reports the
+      # publication as not-landed.
+      if self._released:
+        raise RuntimeError(
+            "BucketedServingEngine was released (arena eviction); "
+            "swap through the arena, which reloads evicted tenants "
+            "from their loader instead.")
       # Holding the lock across the transfer is intentional: only
       # SWAPPERS contend here (the hot path reads the published tuple
       # lock-free), and overlapping transfers of two checkpoint trees
@@ -289,22 +374,35 @@ class BucketedServingEngine:
   def predict(self, features: Any,
               rng: Optional[jax.Array] = None) -> Any:
     """One bucketed dispatch; returns host numpy outputs, unpadded."""
+    if self._released:
+      raise RuntimeError(
+          "BucketedServingEngine was released (arena eviction); "
+          "reload the tenant through the arena instead.")
     leaves = jax.tree_util.tree_leaves(features)
     n = int(np.asarray(leaves[0]).shape[0])
     bucket = bucketing.bucket_for(n, self._table)
-    if bucket not in self._compiled:
+    executable = self._compiled.get(bucket)
+    if executable is None:
       # Cold bucket (warmup skipped): compile once, counted. Never
       # taken after warmup() — the table is fully populated there.
-      self._compile_bucket(bucket)
+      executable = self._compile_bucket(bucket)
     padded = bucketing.pad_batch(features, bucket)
-    # One atomic read: old or new publication, never mixed.
+    # LOCAL references to both the executable (above) and the state
+    # (one atomic publication read — old or new, never mixed): a
+    # release racing in can clear the table and publish the None
+    # sentinel, but this dispatch completes safely on what it already
+    # holds; only a state read AFTER the release fails, clearly.
     state = self._published.state
+    if state is None:
+      raise RuntimeError(
+          "BucketedServingEngine was released (arena eviction); "
+          "reload the tenant through the arena instead.")
     t0 = time.perf_counter()
     with telemetry.span("serving.dispatch", bucket=bucket, rows=n):
       if self._takes_rng:
-        outputs = self._compiled[bucket](state, padded, rng)
+        outputs = executable(state, padded, rng)
       else:
-        outputs = self._compiled[bucket](state, padded)
+        outputs = executable(state, padded)
       outputs = jax.tree_util.tree_map(
           lambda a: np.asarray(jax.device_get(a)), outputs)
     # Registry publication: per-bucket latency (the serving p50/p95
@@ -312,7 +410,7 @@ class BucketedServingEngine:
     hist = self._tm_bucket_ms.get(bucket)
     if hist is None:
       hist = self._tm_bucket_ms[bucket] = tmetrics.histogram(
-          f"serving.bucket_{bucket}_ms")
+          f"{self._metric_prefix}bucket_{bucket}_ms")
     hist.observe((time.perf_counter() - t0) * 1e3)
     self.dispatch_count += 1
     self.dispatches_per_bucket[bucket] = (

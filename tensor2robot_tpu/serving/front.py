@@ -1,0 +1,410 @@
+"""ServingFront: continuous batching across tenants, one dispatcher.
+
+The single-model `MicroBatcher` parks a dispatcher thread per model;
+with N tenants that is N threads each waiting its own deadline while
+the device idles between their dispatches. The front replaces that
+with ONE continuous-batching loop over every tenant's queue:
+
+        tenant queues (bounded, admission-gated)
+  a ──► [r r r]   ╲
+  b ──► [r]        ──► round-robin pick ──► coalesce ≤ max_batch rows
+  c ──► [r r]     ╱         │                of ONE tenant
+                            ▼
+                  arena.engine(tenant).predict(...)   ◄─ LRU touch,
+                            │                            load on miss
+                            ▼
+                  per-request slices → futures, latency stamped
+
+Requests of DIFFERENT tenants never co-batch (different programs);
+continuous batching means the dispatcher never waits between tenants —
+as long as ANY tenant has queued work the device gets back-to-back
+dispatches, and each tenant's batch forms naturally from what queued
+while the device was busy (`max_wait_us=0`, the default, holds nothing;
+a nonzero deadline trades a little latency for fuller batches exactly
+like the micro-batcher).
+
+FAIR SHARE is round-robin with a per-turn cap: each turn serves at
+most one dispatch (≤ the tenant's `max_batch` rows) before the
+pointer advances, so a deep queue cannot starve a shallow one — an
+abusive tenant is first clipped by admission (its own drops), then
+bounded to its 1/N turn share here.
+
+The submit path is the admission pipeline (serving/admission.py):
+token-bucket rate gate → bounded tenant queue with the replay
+service's overflow contract ("drop" counted, "block" with deadline).
+`submit()` after `close()` fails fast — same contract as the
+micro-batcher, pinned by tests.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from tensor2robot_tpu import config as gin
+from tensor2robot_tpu import telemetry
+from tensor2robot_tpu.serving.admission import (
+    AdmissionController,
+    RequestRejected,
+    TenantPolicy,
+    deadline_slices,
+)
+from tensor2robot_tpu.serving.arena import ModelArena
+from tensor2robot_tpu.serving import coalesce
+from tensor2robot_tpu.telemetry import metrics as tmetrics
+
+
+class _Request:
+
+  __slots__ = ("features", "n", "future", "t_submit")
+
+  def __init__(self, features: Any, n: int):
+    self.features = features
+    self.n = n
+    self.future: Future = Future()
+    self.t_submit = time.perf_counter()
+
+
+class _Tenant:
+  """Per-tenant front state: bounded queue + carry + metric handles."""
+
+  __slots__ = ("tenant", "queue", "carry", "rng", "tm_request_ms",
+               "tm_completions", "tm_slo_ok", "tm_queue_depth")
+
+  def __init__(self, tenant: str, max_queue: int, seed: int,
+               takes_rng: bool):
+    self.tenant = tenant
+    self.queue: "queue.Queue[_Request]" = queue.Queue(maxsize=max_queue)
+    self.carry: Optional[_Request] = None
+    self.rng = jax.random.PRNGKey(seed) if takes_rng else None
+    self.tm_request_ms = tmetrics.histogram(
+        f"serving.{tenant}.request_ms")
+    self.tm_completions = tmetrics.counter(
+        f"serving.{tenant}.completions")
+    self.tm_slo_ok = tmetrics.counter(f"serving.{tenant}.slo_ok")
+    self.tm_queue_depth = tmetrics.gauge(
+        f"serving.{tenant}.queue_depth")
+
+  def pending(self) -> bool:
+    return self.carry is not None or not self.queue.empty()
+
+
+@gin.configurable
+class ServingFront:
+  """Multi-tenant serving entry: admission → queues → one dispatcher."""
+
+  def __init__(self,
+               arena: ModelArena,
+               admission: Optional[AdmissionController] = None,
+               max_wait_us: int = 0,
+               seed: int = 0):
+    """Args:
+      arena: the pinned-param pool (tenants register through the
+        front so arena, admission, and queues stay in step).
+      admission: the per-tenant gate; None constructs one with
+        defaults (gin-configured `AdmissionController`).
+      max_wait_us: batch-forming hold per dispatch, like the
+        micro-batcher's. 0 (default) = pure continuous batching —
+        dispatch whatever is queued, never hold the device.
+      seed: base PRNG seed for rng-taking tenants (CEM policies);
+        per-tenant keys fold per dispatch.
+    """
+    self._arena = arena
+    self._admission = admission or AdmissionController()
+    self._max_wait = max_wait_us / 1e6
+    self._seed = int(seed)
+    self._tenants: Dict[str, _Tenant] = {}
+    self._order: List[str] = []
+    self._rr = 0
+    self._dispatch_index = 0
+    self._stop = threading.Event()
+    # Serializes submit()'s closed-check+enqueue against close(), the
+    # micro-batcher's fail-fast contract: a request must never land on
+    # a queue after the dispatcher decided to exit.
+    self._submit_lock = threading.Lock()
+    # Wakeup FLAG, not a token per request: a maxsize-1 queue set by
+    # every submit (put_nowait, Full ignored) and consumed only when
+    # the dispatcher goes idle. One token per request would never be
+    # drained under sustained load (rounds keep finding work) and
+    # grow without bound — the eventfd-style coalesced flag carries
+    # the same no-lost-wakeup guarantee: a submit enqueues its request
+    # BEFORE setting the flag, so after the dispatcher consumes a flag
+    # its next scan sees the request, or a newer flag is already set.
+    self._work: "queue.Queue[bool]" = queue.Queue(maxsize=1)
+    self.dispatches = 0
+    self.requests = 0
+    self.dispatches_per_tenant: Dict[str, int] = {}
+    self._thread = threading.Thread(
+        target=self._run, name="serving-front", daemon=True)
+    self._thread.start()
+
+  @property
+  def arena(self) -> ModelArena:
+    return self._arena
+
+  @property
+  def admission(self) -> AdmissionController:
+    return self._admission
+
+  # ---- registration ----
+
+  def register_tenant(self,
+                      tenant: str,
+                      loader,
+                      policy: Optional[TenantPolicy] = None,
+                      max_batch: int = 8,
+                      takes_rng: bool = False,
+                      warmup: bool = True,
+                      preload: bool = False) -> None:
+    """One call wires a tenant end to end: arena residency spec,
+    admission policy, and the front queue. `preload=True` loads (and
+    AOT-warms) the engine now instead of on first request."""
+    # Validate the policy the tenant will actually get — the explicit
+    # one OR the controller's (gin-configured) default: a bucket of
+    # depth `burst` can NEVER grant `max_batch` tokens, so every
+    # full-size request would shed at any load ("drop") or spin to its
+    # deadline ("block"). Loud at registration, not a 100%-shed
+    # mystery in production. Checked BEFORE any registration so a
+    # rejection leaves no half-registered tenant behind.
+    effective = (policy if policy is not None
+                 else self._admission.policy(tenant))
+    if (effective.rate_rps is not None
+        and effective.burst < max_batch):
+      raise ValueError(
+          f"tenant {tenant!r}: burst={effective.burst} < "
+          f"max_batch={max_batch} — a max-size request could never be "
+          "admitted; raise burst to at least max_batch.")
+    self._arena.register(tenant, loader, max_batch=max_batch,
+                         takes_rng=takes_rng, warmup=warmup)
+    policy = self._admission.register(tenant, policy)
+    entry = _Tenant(tenant, policy.max_queue,
+                    seed=self._seed + len(self._order),
+                    takes_rng=takes_rng)
+    with self._submit_lock:
+      self._tenants[tenant] = entry
+      self._order.append(tenant)
+    if preload:
+      self._arena.engine(tenant)
+
+  # ---- caller side ----
+
+  def submit(self, tenant: str, features: Any) -> Future:
+    """Admission-gated enqueue; returns the request's Future.
+
+    Raises `RequestRejected` when the tenant's token bucket or queue
+    bound sheds it (policy "drop", or "block" past its deadline), and
+    `RuntimeError` after `close()` — fail fast, never enqueue into a
+    dead dispatcher.
+    """
+    entry = self._tenants.get(tenant)
+    if entry is None:
+      raise KeyError(f"tenant {tenant!r} is not registered")
+    leaves = jax.tree_util.tree_leaves(features)
+    n = int(np.asarray(leaves[0]).shape[0])
+    max_batch = self._arena.spec(tenant).max_batch
+    if n > max_batch:
+      raise ValueError(
+          f"request of {n} rows exceeds tenant {tenant!r} max_batch "
+          f"{max_batch}; split it or raise max_batch.")
+    if self._stop.is_set():
+      raise RuntimeError(
+          "ServingFront is closed; submit() after close() would "
+          "enqueue into a dead dispatcher.")
+    if not self._admission.admit(tenant, n, stop=self._stop):
+      raise RequestRejected(
+          tenant, "rate",
+          f"tenant {tenant!r}: over admitted rate "
+          f"(rate_rps={self._admission.policy(tenant).rate_rps}); "
+          "request shed")
+    request = _Request(features, n)
+    policy = self._admission.policy(tenant)
+    if self._try_enqueue(tenant, entry, request):
+      return request.future
+    # Queue full. "drop": count + reject. "block": backpressure in
+    # timed SLEEP slices, each retrying `_try_enqueue` — every attempt
+    # re-checks the closed flag under the submit lock, so a close()
+    # can never be outrun by a late enqueue onto a freed slot
+    # (sleeping happens outside the lock, the replay producers'
+    # timed-put shape). Either shed path refunds the rate tokens the
+    # request spent — unserved rows must not charge the tenant's
+    # future budget. The request keeps its original submit stamp:
+    # time spent blocked here is real latency the SLO accounting
+    # must see.
+    if policy.overflow == "drop":
+      self._admission.queue_full(tenant, n)
+      raise RequestRejected(
+          tenant, "queue_full",
+          f"tenant {tenant!r}: queue full "
+          f"(max_queue={policy.max_queue}); request shed")
+    for slice_secs in deadline_slices(policy.block_timeout_secs):
+      # No stop event here: _try_enqueue re-checks the closed flag
+      # under the submit lock every slice and raises the fail-fast
+      # error itself — a close() mid-wait is noticed within a slice.
+      time.sleep(slice_secs)
+      if self._try_enqueue(tenant, entry, request):
+        return request.future
+    self._admission.queue_full(tenant, n)
+    raise RequestRejected(
+        tenant, "queue_full",
+        f"tenant {tenant!r}: queue full past "
+        f"block_timeout_secs={policy.block_timeout_secs}; "
+        "request shed")
+
+  def _try_enqueue(self, tenant: str, entry: _Tenant,
+                   request: _Request) -> bool:
+    """ONE enqueue attempt; the fail-fast contract lives here, once.
+
+    Closed-check + bounded put + request accounting all happen under
+    the submit lock (close() sets the stop flag under the same lock,
+    so a request can never land on a queue after close() decided to
+    drain); returns False on a full queue. A successful enqueue is
+    what `admitted` MEANS: the request cleared both gates, so the
+    admitted/dropped counters partition offered load with no overlap —
+    including on the closed path: every caller sits past the rate gate
+    (tokens charged), so a close() racing the enqueue refunds and
+    counts the shed before failing fast.
+    """
+    closed = False
+    with self._submit_lock:
+      if self._stop.is_set():
+        closed = True
+      else:
+        try:
+          entry.queue.put_nowait(request)
+        except queue.Full:
+          return False
+        self.requests += 1
+    if closed:
+      # Outside the submit lock: queue_full takes the admission locks.
+      self._admission.queue_full(tenant, request.n)
+      raise RuntimeError(
+          "ServingFront is closed; submit() after close() would "
+          "enqueue into a dead dispatcher.")
+    try:
+      self._work.put_nowait(True)  # coalesced wakeup flag
+    except queue.Full:
+      pass  # a wakeup is already pending — the scan will see us
+    self._admission.count_admitted(tenant, request.n)
+    return True
+
+  def predict(self, tenant: str, features: Any) -> Any:
+    """Blocking predict — submit + wait (a control loop's tick)."""
+    return self.submit(tenant, features).result()
+
+  # ---- dispatcher thread ----
+
+  def _next_tenant(self) -> Optional[_Tenant]:
+    """Round-robin over tenants with pending work (fair share)."""
+    with self._submit_lock:
+      order = list(self._order)
+      start = self._rr
+    count = len(order)
+    for offset in range(count):
+      tenant_id = order[(start + offset) % count]
+      entry = self._tenants[tenant_id]
+      if entry.pending():
+        with self._submit_lock:
+          self._rr = (start + offset + 1) % count
+        return entry
+    return None
+
+  def _run(self) -> None:
+    while True:
+      served = self._serve_round()
+      if served:
+        continue
+      if self._stop.is_set():
+        # Drained: every queue and carry is empty.
+        if all(not t.pending() for t in self._tenants.values()):
+          return
+        continue
+      try:
+        # Idle: park on the wakeup flag. A stale flag costs one empty
+        # scan — never a lost request, never a busy spin.
+        self._work.get(timeout=0.05)
+      except queue.Empty:
+        continue
+
+  def _serve_round(self) -> bool:
+    entry = self._next_tenant()
+    if entry is None:
+      return False
+    max_batch = self._arena.spec(entry.tenant).max_batch
+    batch, entry.carry = coalesce.take_batch(
+        entry.queue, entry.carry, max_batch, self._max_wait)
+    if not batch:
+      return False
+    self._dispatch(entry, batch)
+    return True  # queue entries were consumed either way
+
+  def _dispatch(self, entry: _Tenant, batch: List[_Request]) -> None:
+    # Claim first (shared coalesce contract): requests cancelled while
+    # queued drop out here, survivors can't be cancelled — delivery
+    # can never hit a poisoned future.
+    batch = coalesce.claim_batch(batch)
+    if not batch:
+      return
+    try:
+      rows = sum(r.n for r in batch)
+      entry.tm_queue_depth.set(entry.queue.qsize())
+      features = coalesce.concat_features(batch)
+      # The arena touch: LRU bump, load-on-miss (an evicted tenant
+      # pays its warm reload HERE, on the dispatcher thread — the
+      # latency cliff the compile cache flattens to deserialization).
+      engine = self._arena.engine(entry.tenant)
+      with telemetry.span("serving.front_dispatch",
+                          tenant=entry.tenant,
+                          requests=len(batch), rows=rows):
+        if entry.rng is not None:
+          key = jax.random.fold_in(entry.rng, self._dispatch_index)
+          outputs = engine.predict(features, rng=key)
+        else:
+          outputs = engine.predict(features)
+      self._dispatch_index += 1
+      self.dispatches += 1
+      self.dispatches_per_tenant[entry.tenant] = (
+          self.dispatches_per_tenant.get(entry.tenant, 0) + 1)
+      slo_ms = self._admission.policy(entry.tenant).slo_ms
+      done = time.perf_counter()
+      for request in batch:
+        latency_ms = (done - request.t_submit) * 1e3
+        entry.tm_request_ms.observe(latency_ms)
+        entry.tm_completions.inc()
+        if latency_ms <= slo_ms:
+          entry.tm_slo_ok.inc()
+      coalesce.deliver(batch, outputs)
+    except Exception as exc:  # noqa: BLE001 — deliver to every caller
+      coalesce.fail_batch(batch, exc)
+
+  # ---- lifecycle ----
+
+  def close(self, timeout: float = 30.0) -> None:
+    """Drains queued requests, then stops the dispatcher thread."""
+    with self._submit_lock:
+      self._stop.set()
+    self._thread.join(timeout=timeout)
+    for entry in self._tenants.values():
+      stranded = [entry.carry] if entry.carry is not None else []
+      entry.carry = None
+      while True:
+        try:
+          stranded.append(entry.queue.get_nowait())
+        except queue.Empty:
+          break
+      for request in stranded:
+        if not request.future.done():
+          request.future.set_exception(
+              RuntimeError("ServingFront closed before dispatch."))
+
+  def __enter__(self):
+    return self
+
+  def __exit__(self, *exc):
+    self.close()
+    return False

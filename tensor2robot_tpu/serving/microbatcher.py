@@ -21,7 +21,6 @@ from __future__ import annotations
 
 import queue
 import threading
-import time
 from concurrent.futures import Future
 from typing import Any, Dict, List, Optional
 
@@ -29,6 +28,7 @@ import jax
 import numpy as np
 
 from tensor2robot_tpu import telemetry
+from tensor2robot_tpu.serving import coalesce
 from tensor2robot_tpu.telemetry import metrics as tmetrics
 
 
@@ -90,7 +90,13 @@ class MicroBatcher:
     request = _Request(features, n)
     with self._submit_lock:
       if self._stop.is_set():
-        raise RuntimeError("MicroBatcher is closed.")
+        # Fail fast: the dispatcher thread is (being) stopped, so an
+        # enqueued request would never dispatch and its caller would
+        # block forever on the future (pinned by tests/test_serving.py).
+        raise RuntimeError(
+            "MicroBatcher is closed; submit() after close() would "
+            "enqueue into a dead dispatcher. Create a new MicroBatcher "
+            "(or the multi-tenant ServingFront) instead.")
       self.requests += 1
       self._queue.put(request)
     return request.future
@@ -101,45 +107,23 @@ class MicroBatcher:
 
   # ---- dispatcher thread ----
 
-  def _take_batch(self) -> List[_Request]:
-    """First request (blocking) + whatever coalesces before deadline."""
-    if self._carry is not None:
-      first, self._carry = self._carry, None
-    else:
-      try:
-        first = self._queue.get(timeout=0.05)
-      except queue.Empty:
-        return []
-    batch = [first]
-    rows = first.n
-    deadline = time.perf_counter() + self._max_wait
-    while rows < self._engine.max_batch:
-      remaining = deadline - time.perf_counter()
-      try:
-        # With max_wait_us=0 this still drains already-queued requests
-        # but never holds the first one waiting for arrivals.
-        nxt = (self._queue.get(timeout=remaining) if remaining > 0
-               else self._queue.get_nowait())
-      except queue.Empty:
-        break
-      if rows + nxt.n > self._engine.max_batch:
-        # Doesn't fit this dispatch: carry it over to LEAD the next
-        # one (a FIFO re-put would let later arrivals jump ahead).
-        self._carry = nxt
-        break
-      batch.append(nxt)
-      rows += nxt.n
-    return batch
-
   def _run(self) -> None:
     while (not self._stop.is_set() or not self._queue.empty()
            or self._carry is not None):
-      batch = self._take_batch()
+      batch, self._carry = coalesce.take_batch(
+          self._queue, self._carry, self._engine.max_batch,
+          self._max_wait, first_timeout_secs=0.05)
       if not batch:
         continue
       self._dispatch(batch)
 
   def _dispatch(self, batch: List[_Request]) -> None:
+    # Claim first: a request cancelled while queued is dropped here,
+    # and the survivors can no longer be cancelled — delivery is
+    # race-free (the shared coalesce contract).
+    batch = coalesce.claim_batch(batch)
+    if not batch:
+      return
     try:
       rows = sum(r.n for r in batch)
       # Registry publication: queue depth at dispatch time (requests
@@ -147,10 +131,7 @@ class MicroBatcher:
       # micro-batcher's two load signals.
       self._tm_queue_depth.set(self._queue.qsize())
       self._tm_rows.observe(rows)
-      features = jax.tree_util.tree_map(
-          lambda *leaves: np.concatenate(
-              [np.asarray(a) for a in leaves], axis=0),
-          *[r.features for r in batch])
+      features = coalesce.concat_features(batch)
       with telemetry.span("serving.microbatch_dispatch",
                           requests=len(batch), rows=rows):
         if self._rng is not None:
@@ -161,19 +142,9 @@ class MicroBatcher:
       self._dispatch_index += 1
       self.dispatches += 1
       self.batch_sizes.append(rows)
-      offset = 0
-      for request in batch:
-        lo, hi = offset, offset + request.n
-        # copy(): slices of one shared output buffer would let a
-        # caller's in-place post-processing corrupt its co-batched
-        # callers' rows.
-        request.future.set_result(jax.tree_util.tree_map(
-            lambda a: a[lo:hi].copy(), outputs))
-        offset = hi
+      coalesce.deliver(batch, outputs)
     except Exception as exc:  # noqa: BLE001 — deliver to every caller
-      for request in batch:
-        if not request.future.done():
-          request.future.set_exception(exc)
+      coalesce.fail_batch(batch, exc)
 
   # ---- lifecycle ----
 

@@ -141,6 +141,17 @@ def _reset_jax_cache_latch() -> None:
                 "happened in this process.", exc_info=True)
 
 
+def cache_dir() -> Optional[str]:
+  """The live persistent-cache directory (None = no cache configured).
+
+  The seam warm-load claims check BEFORE promising anything: the
+  serving arena's "evicted tenants reload without recompiling"
+  contract only holds with a cache configured, so it consults this at
+  construction and warns loudly when the answer is None.
+  """
+  return _configured_dir
+
+
 def donation_unsafe_with_cache() -> bool:
   """True when buffer donation must be disabled for cache safety.
 

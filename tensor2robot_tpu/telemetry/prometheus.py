@@ -17,6 +17,20 @@ schema (telemetry/metrics.py) into the text exposition format
 Metric names sanitize to ``[a-zA-Z_:][a-zA-Z0-9_:]*`` (dots and
 dashes — the registry's namespacing convention — become underscores).
 
+PER-TENANT LABELS: the serving tier publishes tenant-scoped metrics
+under ``serving.<tenant>.<rest>`` (engine dispatch histograms, front
+completion counters, admission shed counters — docs/SERVING.md). The
+adapter renders the tenant as a LABEL instead of a name: every tenant's
+``serving.a.bucket_8_ms`` / ``serving.b.bucket_8_ms`` lands in ONE
+``t2r_serving_bucket_8_ms`` family with ``tenant="a"`` / ``tenant="b"``
+series — the Prometheus data model for the same metric across
+entities, so dashboards aggregate and alert across tenants without
+per-tenant queries. The segments ``arena``/``front``/``admission`` are
+RESERVED namespaces (arena pool gauges etc.), never tenants; tenant
+ids are validated against the reservation at registration
+(`serving.arena.RESERVED_TENANT_IDS` — kept in sync by a cross-module
+test).
+
 `serve()` is the ~endpoint: a daemon-threaded stdlib HTTP server
 answering ``GET /metrics``, snapshotting at scrape time. jax-free BY
 CONTRACT like the rest of the package (IMP401 worker-safe set) — an
@@ -35,6 +49,13 @@ from tensor2robot_tpu.telemetry import metrics as metrics_lib
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
+# Middle segments of `serving.<x>.*` that are serving SUBSYSTEM
+# namespaces, not tenants. Must cover serving/arena.py's
+# RESERVED_TENANT_IDS (tenant registration rejects these ids; a
+# cross-module test pins the two sets against each other without
+# importing jax here — this module stays worker-safe).
+RESERVED_SERVING_NAMESPACES = frozenset({"arena", "front", "admission"})
+
 
 def _sanitize(name: str) -> str:
   name = _NAME_RE.sub("_", name)
@@ -47,31 +68,78 @@ def _fmt(value) -> str:
   return repr(float(value))
 
 
+def _split_tenant(name: str):
+  """`serving.<tenant>.<rest>` → (`serving.<rest>`, tenant); anything
+  else (incl. the reserved serving namespaces) passes through."""
+  parts = name.split(".")
+  if (len(parts) >= 3 and parts[0] == "serving"
+      and parts[1] not in RESERVED_SERVING_NAMESPACES):
+    return "serving." + ".".join(parts[2:]), parts[1]
+  return name, None
+
+
+def _escape_label(value: str) -> str:
+  return (value.replace("\\", r"\\").replace('"', r'\"')
+          .replace("\n", r"\n"))
+
+
+def _labels(tenant: Optional[str], extra: str = "") -> str:
+  items = []
+  if tenant is not None:
+    items.append(f'tenant="{_escape_label(tenant)}"')
+  if extra:
+    items.append(extra)
+  return "{" + ",".join(items) + "}" if items else ""
+
+
 def render_text(snapshot: Optional[Dict] = None,
                 prefix: str = "t2r_") -> str:
   """One scrape body from a registry snapshot (default: the
-  process-wide registry, snapshotted now)."""
+  process-wide registry, snapshotted now). Tenant-scoped serving
+  metrics merge into one family per metric with a ``tenant`` label;
+  each family's ``# TYPE`` line is emitted exactly once."""
   if snapshot is None:
     snapshot = metrics_lib.registry().snapshot()
   lines = []
-  for name, value in sorted(snapshot.get("counters", {}).items()):
-    metric = prefix + _sanitize(name)
+
+  def families_of(section):
+    """name → family metric + per-series (tenant, payload) rows,
+    grouped so multi-tenant series share one TYPE header."""
+    families: Dict[str, list] = {}
+    for name, payload in section.items():
+      base, tenant = _split_tenant(name)
+      families.setdefault(base, []).append((tenant, payload))
+    for base in sorted(families):
+      # Stable series order: unlabeled first, then tenants sorted.
+      series = sorted(families[base],
+                      key=lambda row: (row[0] is not None, row[0]))
+      yield base, series
+
+  for base, series in families_of(snapshot.get("counters", {})):
+    metric = prefix + _sanitize(base)
     if not metric.endswith("_total"):
       metric += "_total"
-    lines += [f"# TYPE {metric} counter", f"{metric} {_fmt(value)}"]
-  for name, value in sorted(snapshot.get("gauges", {}).items()):
-    metric = prefix + _sanitize(name)
-    lines += [f"# TYPE {metric} gauge", f"{metric} {_fmt(value)}"]
-  for name, hist in sorted(snapshot.get("histograms", {}).items()):
-    metric = prefix + _sanitize(name)
+    lines.append(f"# TYPE {metric} counter")
+    for tenant, value in series:
+      lines.append(f"{metric}{_labels(tenant)} {_fmt(value)}")
+  for base, series in families_of(snapshot.get("gauges", {})):
+    metric = prefix + _sanitize(base)
+    lines.append(f"# TYPE {metric} gauge")
+    for tenant, value in series:
+      lines.append(f"{metric}{_labels(tenant)} {_fmt(value)}")
+  for base, series in families_of(snapshot.get("histograms", {})):
+    metric = prefix + _sanitize(base)
     lines.append(f"# TYPE {metric} histogram")
-    running = 0
-    for bound, count in zip(hist["bounds"], hist["counts"]):
-      running += count
-      lines.append(f'{metric}_bucket{{le="{_fmt(bound)}"}} {running}')
-    lines.append(f'{metric}_bucket{{le="+Inf"}} {hist["count"]}')
-    lines.append(f"{metric}_sum {_fmt(hist['sum'])}")
-    lines.append(f"{metric}_count {hist['count']}")
+    for tenant, hist in series:
+      running = 0
+      for bound, count in zip(hist["bounds"], hist["counts"]):
+        running += count
+        bucket_labels = _labels(tenant, f'le="{_fmt(bound)}"')
+        lines.append(f"{metric}_bucket{bucket_labels} {running}")
+      inf_labels = _labels(tenant, 'le="+Inf"')
+      lines.append(f'{metric}_bucket{inf_labels} {hist["count"]}')
+      lines.append(f"{metric}_sum{_labels(tenant)} {_fmt(hist['sum'])}")
+      lines.append(f"{metric}_count{_labels(tenant)} {hist['count']}")
   return "\n".join(lines) + "\n"
 
 

@@ -236,6 +236,26 @@ class TestMicroBatcher:
       with pytest.raises(ValueError, match="max_batch"):
         batcher.predict(batch)
 
+  def test_submit_after_close_fails_fast(self):
+    """ISSUE 13 satellite: a submit after close() must raise a clear
+    error immediately — never enqueue into the dead dispatcher and
+    strand its caller on a future that will never resolve."""
+    model, engine = _make_engine(max_batch=4)
+    spec = _wire_spec(model)
+    batcher = MicroBatcher(engine, max_wait_us=0)
+    batch = specs.make_random_tensors(spec, batch_size=1, seed=11)
+    assert jax.tree_util.tree_leaves(
+        batcher.predict(batch))[0].shape[0] == 1
+    batcher.close()
+    t0 = time.perf_counter()
+    with pytest.raises(RuntimeError, match="closed"):
+      batcher.submit(batch)
+    assert time.perf_counter() - t0 < 1.0  # fail FAST, not a timeout
+    # Idempotent close keeps the contract.
+    batcher.close()
+    with pytest.raises(RuntimeError, match="closed"):
+      batcher.predict(batch)
+
   def test_dispatch_errors_propagate_to_callers(self):
     model, engine = _make_engine(max_batch=4)
     with MicroBatcher(engine, max_wait_us=0) as batcher:

@@ -1,0 +1,680 @@
+"""Tests for the multi-tenant serving front (ISSUE 13).
+
+Pins the contracts docs/SERVING.md §"Multi-tenant front" promises:
+  * arena: budgeted LRU residency — loading past the budget evicts the
+    least-recently-dispatched tenant; an evicted tenant's reload is
+    COMPILE-CACHE-WARM (`cache_misses == 0`, the startup/compile_cache
+    seam); a single tenant over the whole budget is a config error;
+  * admission: per-tenant token-bucket rate + bounded queue with the
+    replay overflow contract — "drop" rejects + counts immediately,
+    "block" applies backpressure up to its deadline then counts a drop;
+    shed counters land in the telemetry registry
+    (`serving.<tenant>.admission.*`);
+  * front: one continuous-batching dispatcher serves every tenant
+    round-robin (a deep queue cannot starve a shallow one), per-caller
+    results are exactly the tenant's own rows, `submit()` after
+    `close()` fails fast;
+  * hot-swap under multi-tenant traffic: swapping tenant A's params
+    mid-traffic never stalls or recompiles tenant B (zero-recompile
+    pin via `engine.compile_count()`);
+  * SLO accounting keys on the per-tenant `serving.<t>.bucket_<n>_ms`
+    histograms the engine already publishes.
+
+The model bodies are tiny pure matmuls: the contracts under test are
+scheduling, budgeting, and accounting — not network math (the engine's
+numerics are pinned in tests/test_serving.py).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from tensor2robot_tpu.serving import (
+    AdmissionController,
+    ModelArena,
+    RequestRejected,
+    ServingFront,
+    TenantPolicy,
+)
+from tensor2robot_tpu.serving import arena as arena_lib
+from tensor2robot_tpu.serving import engine as engine_lib
+from tensor2robot_tpu.startup import compile_cache
+from tensor2robot_tpu.telemetry import metrics as tmetrics
+from tensor2robot_tpu.telemetry import prometheus
+
+
+@pytest.fixture(autouse=True)
+def _isolate():
+  """Fresh registry per test; detach the persistent compile cache so a
+  tmp-path cache never leaks into later tests' engines."""
+  tmetrics.reset_for_tests()
+  yield
+  compile_cache.reset_compilation_cache_config()
+  tmetrics.reset_for_tests()
+
+
+def make_loader(scale, side=8, calls=None):
+  """Loader for a tenant whose output is `x @ (scale * I)` — outputs
+  identify the tenant AND the params generation."""
+  def loader():
+    if calls is not None:
+      calls.append(scale)
+    params = {"w": np.eye(side, dtype=np.float32) * scale}
+    def fn(state, feats):
+      return {"y": feats["x"] @ state["w"]}
+    example = {"x": np.zeros((1, side), np.float32)}
+    return fn, params, example
+  return loader
+
+
+def ones(n, side=8):
+  return {"x": np.ones((n, side), np.float32)}
+
+
+def make_front(tmp_path, admission=None, **front_kwargs):
+  arena = ModelArena(budget_bytes=None,
+                     cache_dir=str(tmp_path / "xla_cache"))
+  return ServingFront(arena, admission, **front_kwargs)
+
+
+class TestArena:
+
+  def test_lru_eviction_at_budget(self, tmp_path):
+    arena = ModelArena(budget_bytes=2 * 8 * 8 * 4,
+                       cache_dir=str(tmp_path / "cache"))
+    for tenant, scale in (("a", 1.0), ("b", 2.0), ("c", 3.0)):
+      arena.register(tenant, make_loader(scale), max_batch=1)
+    arena.engine("a")
+    arena.engine("b")
+    arena.engine("a")  # LRU touch: b is now least recent
+    assert arena.resident_tenants() == ("b", "a")
+    arena.engine("c")  # over budget: evicts b, not a
+    assert set(arena.stats()["resident"]) == {"a", "c"}
+    assert arena.evictions == 1
+    assert arena.resident_bytes() <= arena.budget_bytes
+    snap = tmetrics.registry().snapshot()
+    assert snap["counters"]["serving.arena.evictions"] == 1.0
+    assert snap["gauges"]["serving.arena.resident_models"] == 2.0
+
+  def test_eviction_reload_is_compile_cache_warm(self, tmp_path):
+    """THE arena perf contract: an evicted tenant's reload
+    deserializes every bucket from the persistent cache instead of
+    recompiling — `cache_misses == 0` on the reload."""
+    arena = ModelArena(budget_bytes=None,
+                       cache_dir=str(tmp_path / "cache"))
+    arena.register("a", make_loader(5.0), max_batch=2)
+    engine = arena.engine("a")
+    out = engine.predict(ones(1))
+    np.testing.assert_allclose(out["y"], 5.0)
+    assert arena.evict("a")
+    reloaded = arena.engine("a")
+    assert reloaded is not engine
+    stats = arena.stats()
+    assert stats["reloads"] == 1
+    assert stats["reload_cache_misses"] == 0, stats
+    assert stats["last_load"]["cache_misses"] == 0
+    out = reloaded.predict(ones(2))
+    np.testing.assert_allclose(out["y"], 5.0)
+
+  def test_single_tenant_over_budget_raises(self, tmp_path):
+    arena = ModelArena(budget_bytes=16,
+                       cache_dir=str(tmp_path / "cache"))
+    arena.register("big", make_loader(1.0), max_batch=1)
+    with pytest.raises(ValueError, match="budget"):
+      arena.engine("big")
+
+  def test_tenant_id_validation(self, tmp_path):
+    arena = ModelArena(cache_dir=str(tmp_path / "cache"))
+    with pytest.raises(ValueError, match="reserved"):
+      arena.register("arena", make_loader(1.0))
+    with pytest.raises(ValueError, match="must match"):
+      arena.register("bad.tenant", make_loader(1.0))
+    with pytest.raises(KeyError):
+      arena.engine("never_registered")
+    arena.register("ok-tenant_1", make_loader(1.0))
+    with pytest.raises(ValueError, match="already registered"):
+      arena.register("ok-tenant_1", make_loader(1.0))
+
+  def test_reserved_ids_match_prometheus_namespaces(self):
+    # The adapter's label heuristic and the arena's id validation must
+    # agree, or a tenant could impersonate a subsystem namespace.
+    assert (arena_lib.RESERVED_TENANT_IDS
+            == prometheus.RESERVED_SERVING_NAMESPACES)
+
+  def test_swap_state_resident_vs_evicted(self, tmp_path):
+    arena = ModelArena(cache_dir=str(tmp_path / "cache"))
+    arena.register("a", make_loader(1.0), max_batch=1)
+    new_params = {"w": np.eye(8, dtype=np.float32) * 9.0}
+    assert not arena.swap_state("a", new_params)  # not resident yet
+    engine = arena.engine("a")
+    assert arena.swap_state("a", new_params, learner_step=7)
+    np.testing.assert_allclose(engine.predict(ones(1))["y"], 9.0)
+    assert engine.params_learner_step == 7
+    with pytest.raises(KeyError):
+      arena.swap_state("ghost", new_params)
+
+  def test_released_engine_fails_fast_not_corrupt(self, tmp_path):
+    """Eviction retires the engine: a stale handle's predict raises a
+    clear error (never dispatches on dropped params), while the arena
+    path reloads transparently."""
+    arena = ModelArena(cache_dir=str(tmp_path / "cache"))
+    arena.register("a", make_loader(2.0), max_batch=1)
+    stale = arena.engine("a")
+    arena.evict("a")
+    assert stale.released
+    with pytest.raises(RuntimeError, match="released"):
+      stale.predict(ones(1))
+    with pytest.raises(RuntimeError, match="released"):
+      stale.swap_state({"w": np.eye(8, dtype=np.float32)})
+    np.testing.assert_allclose(arena.engine("a").predict(ones(1))["y"],
+                               2.0)
+
+  def test_reload_uses_loader_fresh_state(self, tmp_path):
+    """The loader is the source of truth on reload: a production
+    loader re-reads the newest checkpoint, so eviction never serves
+    stale params after reload."""
+    calls = []
+    arena = ModelArena(cache_dir=str(tmp_path / "cache"))
+    arena.register("a", make_loader(4.0, calls=calls), max_batch=1)
+    arena.engine("a")
+    arena.evict("a")
+    arena.engine("a")
+    assert calls == [4.0, 4.0]  # loader ran once per load
+
+
+class TestAdmission:
+
+  def test_token_bucket_sheds_over_burst(self, tmp_path):
+    policy = TenantPolicy(rate_rps=0.01, burst=2, overflow="drop",
+                          slo_ms=1000.0)
+    with make_front(tmp_path) as front:
+      front.register_tenant("a", make_loader(1.0), policy=policy,
+                            max_batch=2, preload=True)
+      futures = [front.submit("a", ones(1)) for _ in range(2)]
+      with pytest.raises(RequestRejected) as exc:
+        front.submit("a", ones(1))
+      assert exc.value.reason == "rate"
+      assert exc.value.tenant == "a"
+      for future in futures:
+        np.testing.assert_allclose(future.result()["y"], 1.0)
+    snap = tmetrics.registry().snapshot()
+    assert snap["counters"]["serving.a.admission.dropped"] == 1.0
+    assert snap["counters"]["serving.a.admission.shed_rate"] == 1.0
+    assert snap["counters"]["serving.a.admission.admitted"] == 2.0
+
+  def test_token_bucket_refills(self, tmp_path):
+    policy = TenantPolicy(rate_rps=200.0, burst=1, overflow="drop",
+                          slo_ms=1000.0)
+    with make_front(tmp_path) as front:
+      front.register_tenant("a", make_loader(1.0), policy=policy,
+                            max_batch=1, preload=True)
+      front.predict("a", ones(1))
+      time.sleep(0.05)  # 200 rps: ~10 tokens refill
+      np.testing.assert_allclose(
+          front.predict("a", ones(1))["y"], 1.0)
+
+  def test_block_policy_waits_for_tokens(self, tmp_path):
+    policy = TenantPolicy(rate_rps=50.0, burst=1, overflow="block",
+                          block_timeout_secs=5.0, slo_ms=1000.0)
+    with make_front(tmp_path) as front:
+      front.register_tenant("a", make_loader(1.0), policy=policy,
+                            max_batch=1, preload=True)
+      front.predict("a", ones(1))  # spends the burst
+      t0 = time.perf_counter()
+      out = front.predict("a", ones(1))  # waits ~20ms for a token
+      waited = time.perf_counter() - t0
+      np.testing.assert_allclose(out["y"], 1.0)
+      assert waited >= 0.01, waited
+
+  def _front_with_stuck_dispatcher(self, tmp_path, policy):
+    """A front whose dispatcher is parked inside a slow tenant's
+    loader — deterministic queue buildup for the bound tests."""
+    release = threading.Event()
+    loaded = threading.Event()
+    base_loader = make_loader(3.0)
+
+    def slow_loader():
+      loaded.set()
+      release.wait(timeout=30.0)
+      return base_loader()
+
+    front = make_front(tmp_path)
+    front.register_tenant("slow", slow_loader,
+                          policy=TenantPolicy(slo_ms=1000.0))
+    front.register_tenant("x", make_loader(1.0), policy=policy,
+                          preload=True)
+    slow_future = front.submit("slow", ones(1))
+    assert loaded.wait(timeout=10.0)  # dispatcher is now stuck
+    return front, release, slow_future
+
+  def test_bounded_queue_drop_counts_and_rejects(self, tmp_path):
+    policy = TenantPolicy(max_queue=2, overflow="drop", slo_ms=1000.0)
+    front, release, slow_future = self._front_with_stuck_dispatcher(
+        tmp_path, policy)
+    try:
+      queued = [front.submit("x", ones(1)) for _ in range(2)]
+      with pytest.raises(RequestRejected) as exc:
+        front.submit("x", ones(1))
+      assert exc.value.reason == "queue_full"
+    finally:
+      release.set()
+    for future in queued:
+      np.testing.assert_allclose(future.result(timeout=30)["y"], 1.0)
+    np.testing.assert_allclose(
+        slow_future.result(timeout=30)["y"], 3.0)
+    front.close()
+    snap = tmetrics.registry().snapshot()
+    assert snap["counters"]["serving.x.admission.shed_queue"] == 1.0
+    assert snap["counters"]["serving.x.admission.dropped"] == 1.0
+
+  def test_bounded_queue_block_deadline_drops(self, tmp_path):
+    policy = TenantPolicy(max_queue=1, overflow="block",
+                          block_timeout_secs=0.3, slo_ms=1000.0)
+    front, release, slow_future = self._front_with_stuck_dispatcher(
+        tmp_path, policy)
+    try:
+      first = front.submit("x", ones(1))
+      t0 = time.perf_counter()
+      with pytest.raises(RequestRejected) as exc:
+        front.submit("x", ones(1))
+      waited = time.perf_counter() - t0
+      assert exc.value.reason == "queue_full"
+      assert waited >= 0.25, waited  # actually blocked to the deadline
+    finally:
+      release.set()
+    np.testing.assert_allclose(first.result(timeout=30)["y"], 1.0)
+    slow_future.result(timeout=30)
+    front.close()
+
+  def test_burst_below_max_batch_rejected_at_registration(self, tmp_path):
+    # A bucket of depth burst can never grant max_batch tokens — every
+    # full-size request would shed forever; loud at registration.
+    with make_front(tmp_path) as front:
+      with pytest.raises(ValueError, match="burst"):
+        front.register_tenant(
+            "a", make_loader(1.0), max_batch=8,
+            policy=TenantPolicy(rate_rps=100.0, burst=4))
+      # Unlimited-rate tenants have no bucket: any burst is fine.
+      front.register_tenant(
+          "b", make_loader(1.0), max_batch=8,
+          policy=TenantPolicy(rate_rps=None, burst=1, slo_ms=1000.0))
+    # The guard must also see the CONTROLLER'S default policy — the
+    # one a policy=None tenant actually inherits (gin-configured).
+    front = make_front(tmp_path,
+                       AdmissionController(rate_rps=100.0, burst=4,
+                                           slo_ms=1000.0))
+    try:
+      with pytest.raises(ValueError, match="burst"):
+        front.register_tenant("c", make_loader(1.0), max_batch=8)
+      front.register_tenant("d", make_loader(1.0), max_batch=4)
+    finally:
+      front.close()
+
+  def test_queue_shed_refunds_rate_tokens(self):
+    # A request shed at the QUEUE gate must not charge the tenant's
+    # rate budget: its tokens come back (rate ~0 so no refill noise).
+    controller = AdmissionController()
+    controller.register("t", TenantPolicy(rate_rps=0.001, burst=2,
+                                          slo_ms=100.0))
+    assert controller.admit("t", 2)      # spends the whole burst
+    assert not controller.admit("t", 2)  # empty: shed at rate
+    controller.queue_full("t", 2)        # queue shed refunds
+    assert controller.admit("t", 2)      # budget restored
+    snap = tmetrics.registry().snapshot()
+    # admitted counts only AFTER the queue gate (the front calls
+    # count_admitted post-enqueue): admit() alone must not count it.
+    assert "serving.t.admission.admitted" not in snap["counters"]
+    controller.count_admitted("t", 2)
+    snap = tmetrics.registry().snapshot()
+    assert snap["counters"]["serving.t.admission.admitted"] == 2.0
+    assert snap["counters"]["serving.t.admission.shed_rate"] == 2.0
+    assert snap["counters"]["serving.t.admission.shed_queue"] == 2.0
+    assert snap["counters"]["serving.t.admission.dropped"] == 4.0
+
+  def test_close_during_block_wait_counts_shed(self, tmp_path):
+    """A close() racing a queue-full block wait must still account the
+    request (refund + shed counters) before failing fast — admitted
+    and dropped partition offered load even across shutdown."""
+    policy = TenantPolicy(max_queue=1, overflow="block",
+                          block_timeout_secs=30.0, slo_ms=1000.0)
+    front, release, slow_future = self._front_with_stuck_dispatcher(
+        tmp_path, policy)
+    first = front.submit("x", ones(1))  # fills the queue
+    outcome = {}
+
+    def blocked_submit():
+      try:
+        front.submit("x", ones(1))
+        outcome["kind"] = "enqueued"
+      except RequestRejected:
+        outcome["kind"] = "rejected"
+      except RuntimeError:
+        outcome["kind"] = "closed"
+
+    submitter = threading.Thread(target=blocked_submit)
+    submitter.start()
+    time.sleep(0.3)  # parked in the deadline_slices wait
+    threading.Timer(1.0, release.set).start()
+    front.close()
+    submitter.join(timeout=10)
+    assert outcome["kind"] == "closed", outcome
+    snap = tmetrics.registry().snapshot()
+    assert snap["counters"]["serving.x.admission.shed_queue"] == 1.0
+    assert snap["counters"]["serving.x.admission.dropped"] == 1.0
+    # The request that DID enqueue was still served by the drain.
+    np.testing.assert_allclose(first.result(timeout=30)["y"], 1.0)
+    slow_future.result(timeout=30)
+
+  def test_slo_report_keys_on_bucket_histograms(self):
+    # Synthesized per-tenant dispatch histograms: the report must merge
+    # a tenant's buckets and score them against its slo_ms.
+    controller = AdmissionController(slo_ms=10.0)
+    controller.register("a")
+    controller.register("b", TenantPolicy(slo_ms=1.0))
+    bounds = (1.0, 10.0, 100.0)
+    hist_a1 = tmetrics.histogram("serving.a.bucket_1_ms", bounds=bounds)
+    hist_a2 = tmetrics.histogram("serving.a.bucket_2_ms", bounds=bounds)
+    for value in (0.5, 5.0):
+      hist_a1.observe(value)
+    hist_a2.observe(50.0)
+    tmetrics.histogram("serving.b.bucket_1_ms", bounds=bounds)
+    # End-to-end view: queueing-inclusive request_ms diverges from the
+    # dispatch view under load — both must be reported.
+    e2e = tmetrics.histogram("serving.a.request_ms", bounds=bounds)
+    for value in (0.5, 50.0, 50.0, 50.0):
+      e2e.observe(value)
+    report = controller.slo_report()
+    assert report["a"]["count"] == 3
+    assert report["a"]["slo_ms"] == 10.0
+    # 2 of 3 observations ≤ 10ms (bucket-exact: 10.0 is a bucket edge).
+    assert report["a"]["in_slo_fraction"] == pytest.approx(
+        2 / 3, abs=1e-3)
+    assert report["a"]["p50_ms"] <= 10.0 < report["a"]["p99_ms"]
+    assert report["a"]["e2e_count"] == 4
+    assert report["a"]["e2e_in_slo_fraction"] == pytest.approx(
+        0.25, abs=1e-3)
+    assert report["a"]["e2e_p95_ms"] > report["a"]["p95_ms"]
+    assert report["b"]["count"] == 0
+    assert "e2e_count" not in report["b"]
+
+  def test_slo_report_overflow_bucket_is_honest(self):
+    """Observations above the top histogram bound must not read as
+    in-SLO unless the observed max proves it, and the tail quantile
+    reports the observed max, not the clamped top bound."""
+    controller = AdmissionController()
+    controller.register("t", TenantPolicy(slo_ms=200.0))
+    hist = tmetrics.histogram("serving.t.bucket_1_ms",
+                              bounds=(1.0, 10.0, 100.0))
+    hist.observe(0.5)
+    hist.observe(50_000.0)  # a multi-minute stall in the overflow
+    report = controller.slo_report()
+    # SLO 200 > top bound 100: the stall is NOT blessed as in-SLO.
+    assert report["t"]["in_slo_fraction"] == pytest.approx(0.5)
+    # The tail reads the observed max, not 100.0.
+    assert report["t"]["p99_ms"] == pytest.approx(50_000.0)
+    # With an SLO the observed max provably satisfies, overflow counts.
+    controller2 = AdmissionController()
+    controller2.register("u", TenantPolicy(slo_ms=1e9))
+    tmetrics.histogram("serving.u.bucket_1_ms",
+                       bounds=(1.0, 10.0)).observe(500.0)
+    assert (controller2.slo_report()["u"]["in_slo_fraction"]
+            == pytest.approx(1.0))
+
+  def test_claim_batch_tolerates_finished_futures(self):
+    # A racing close() may have already failed a queued request; the
+    # dispatcher's claim must skip it, not die mid-batch.
+    from concurrent.futures import Future
+
+    from tensor2robot_tpu.serving import coalesce
+
+    class Req:
+      def __init__(self):
+        self.future = Future()
+        self.n = 1
+        self.features = {"x": np.zeros((1, 2), np.float32)}
+
+    live, cancelled, failed = Req(), Req(), Req()
+    cancelled.future.cancel()
+    failed.future.set_exception(RuntimeError("closed before dispatch"))
+    claimed = coalesce.claim_batch([live, cancelled, failed])
+    assert claimed == [live]
+
+
+class TestFront:
+
+  def test_cross_tenant_results_are_exact(self, tmp_path):
+    with make_front(tmp_path) as front:
+      front.register_tenant("a", make_loader(2.0), max_batch=4,
+                            preload=True)
+      front.register_tenant("b", make_loader(10.0), max_batch=4,
+                            preload=True)
+      barrier = threading.Barrier(8)
+      results = {}
+
+      def caller(index, tenant, scale):
+        feats = {"x": np.full((1, 8), float(index), np.float32)}
+        barrier.wait()
+        results[index] = (front.predict(tenant, feats), scale, index)
+
+      threads = [
+          threading.Thread(
+              target=caller,
+              args=(i, "a" if i % 2 else "b", 2.0 if i % 2 else 10.0))
+          for i in range(8)
+      ]
+      for thread in threads:
+        thread.start()
+      for thread in threads:
+        thread.join(timeout=60)
+      assert len(results) == 8
+      for out, scale, index in results.values():
+        np.testing.assert_allclose(out["y"], scale * index)
+      # Coalescing across the 8 callers: strictly fewer dispatches.
+      assert front.dispatches < 8
+      assert set(front.dispatches_per_tenant) == {"a", "b"}
+      # The wakeup channel is a coalesced FLAG, not a token per
+      # request — sustained load must not grow it.
+      assert front._work.qsize() <= 1
+
+  def test_round_robin_fair_share(self, tmp_path):
+    """A deep queue (6 waiting requests) must not starve a shallow one
+    (2): round-robin serves B's first dispatch before A's last."""
+    release = threading.Event()
+
+    def slow_loader():
+      release.wait(timeout=30.0)
+      return make_loader(1.0)()
+
+    front = make_front(tmp_path)
+    front.register_tenant("slow", slow_loader,
+                          policy=TenantPolicy(slo_ms=1000.0))
+    front.register_tenant("a", make_loader(1.0), max_batch=2,
+                          preload=True)
+    front.register_tenant("b", make_loader(2.0), max_batch=2,
+                          preload=True)
+    order = []
+
+    def track(tenant):
+      def _done(_):
+        order.append(tenant)
+      return _done
+
+    try:
+      stuck = front.submit("slow", ones(1))
+      time.sleep(0.1)  # dispatcher parks inside slow's loader
+      futures = []
+      for _ in range(6):
+        future = front.submit("a", ones(1))
+        future.add_done_callback(track("a"))
+        futures.append(future)
+      for _ in range(2):
+        future = front.submit("b", ones(1))
+        future.add_done_callback(track("b"))
+        futures.append(future)
+    finally:
+      release.set()
+    for future in futures:
+      future.result(timeout=30)
+    stuck.result(timeout=30)
+    front.close()
+    first_b = order.index("b")
+    last_a = len(order) - 1 - order[::-1].index("a")
+    assert first_b < last_a, order
+
+  def test_cancelled_request_never_poisons_co_batched_callers(
+      self, tmp_path):
+    """A caller cancelling its queued future must not cost the
+    requests coalesced around it their results (the claim-then-deliver
+    contract in serving/coalesce.py)."""
+    release = threading.Event()
+
+    def slow_loader():
+      release.wait(timeout=30.0)
+      return make_loader(1.0)()
+
+    front = make_front(tmp_path)
+    front.register_tenant("slow", slow_loader,
+                          policy=TenantPolicy(slo_ms=1000.0))
+    front.register_tenant("x", make_loader(5.0), max_batch=4,
+                          preload=True)
+    try:
+      stuck = front.submit("slow", ones(1))
+      time.sleep(0.1)  # dispatcher parks inside slow's loader
+      before = front.submit("x", ones(1))
+      doomed = front.submit("x", ones(1))
+      after = front.submit("x", ones(1))
+      assert doomed.cancel()  # still queued: cancel wins
+    finally:
+      release.set()
+    # The co-batched neighbors get exactly their own rows.
+    np.testing.assert_allclose(before.result(timeout=30)["y"], 5.0)
+    np.testing.assert_allclose(after.result(timeout=30)["y"], 5.0)
+    assert doomed.cancelled()
+    stuck.result(timeout=30)
+    front.close()
+
+  def test_microbatcher_tolerates_cancelled_requests(self):
+    """Same contract on the single-model path (shared coalesce)."""
+    from tensor2robot_tpu.serving import BucketedServingEngine
+    from tensor2robot_tpu.serving import MicroBatcher
+
+    params = {"w": np.eye(4, dtype=np.float32) * 3.0}
+    engine = BucketedServingEngine(
+        lambda state, feats: {"y": feats["x"] @ state["w"]},
+        params, {"x": np.zeros((1, 4), np.float32)}, max_batch=4)
+    engine.warmup()
+    with MicroBatcher(engine, max_wait_us=100_000) as batcher:
+      first = batcher.submit({"x": np.ones((1, 4), np.float32)})
+      second = batcher.submit({"x": np.ones((1, 4), np.float32)})
+      won = second.cancel()  # racing the dispatcher: either side may win
+      np.testing.assert_allclose(
+          first.result(timeout=30)["y"], 3.0)
+      if won:
+        assert second.cancelled()
+      else:
+        np.testing.assert_allclose(
+            second.result(timeout=30)["y"], 3.0)
+
+  def test_submit_after_close_fails_fast(self, tmp_path):
+    front = make_front(tmp_path)
+    front.register_tenant("a", make_loader(1.0), preload=True)
+    front.predict("a", ones(1))
+    front.close()
+    with pytest.raises(RuntimeError, match="closed"):
+      front.submit("a", ones(1))
+
+  def test_unknown_tenant_and_oversized_request(self, tmp_path):
+    with make_front(tmp_path) as front:
+      front.register_tenant("a", make_loader(1.0), max_batch=2,
+                            preload=True)
+      with pytest.raises(KeyError):
+        front.submit("ghost", ones(1))
+      with pytest.raises(ValueError, match="max_batch"):
+        front.submit("a", ones(3))
+
+  def test_rng_tenants_get_folded_keys(self, tmp_path):
+    def loader():
+      params = {"w": np.zeros((1,), np.float32)}
+      def fn(state, feats, rng):
+        noise = jax.random.uniform(rng, (1, 1))
+        return {"y": feats["x"][:, :1] * 0.0 + state["w"] + noise}
+      example = {"x": np.zeros((1, 8), np.float32)}
+      return fn, params, example
+
+    with make_front(tmp_path) as front:
+      front.register_tenant("cem", loader, takes_rng=True,
+                            preload=True)
+      first = front.predict("cem", ones(1))["y"]
+      second = front.predict("cem", ones(1))["y"]
+      # Distinct dispatches fold distinct keys: noise differs.
+      assert not np.array_equal(first, second)
+
+  def test_completion_metrics_published(self, tmp_path):
+    with make_front(tmp_path) as front:
+      front.register_tenant(
+          "a", make_loader(1.0),
+          policy=TenantPolicy(slo_ms=60_000.0), preload=True)
+      for _ in range(3):
+        front.predict("a", ones(1))
+    snap = tmetrics.registry().snapshot()
+    assert snap["counters"]["serving.a.completions"] == 3.0
+    assert snap["counters"]["serving.a.slo_ok"] == 3.0
+    assert snap["histograms"]["serving.a.request_ms"]["count"] == 3
+    # The engine's per-tenant dispatch histograms exist too — the SLO
+    # accounting seam.
+    assert any(name.startswith("serving.a.bucket_")
+               for name in snap["histograms"])
+
+
+class TestMultiTenantHotSwap:
+
+  def test_swap_a_never_stalls_or_recompiles_b(self, tmp_path):
+    """ISSUE 13 satellite: hot-swapping tenant A's checkpoint under
+    multi-tenant traffic must not stall or recompile tenant B."""
+    with make_front(tmp_path) as front:
+      front.register_tenant("a", make_loader(1.0), max_batch=2,
+                            preload=True)
+      front.register_tenant("b", make_loader(100.0), max_batch=2,
+                            preload=True)
+      front.predict("b", ones(1))  # warm the dispatch path
+      compiles_before = engine_lib.compile_count()
+
+      stop = threading.Event()
+      b_outputs = []
+      b_errors = []
+
+      def b_traffic():
+        while not stop.is_set():
+          try:
+            out = front.predict("b", ones(1))
+            b_outputs.append(float(out["y"][0, 0]))
+          except Exception as exc:  # noqa: BLE001 — the pin IS no-error
+            b_errors.append(exc)
+            return
+
+      threads = [threading.Thread(target=b_traffic) for _ in range(2)]
+      for thread in threads:
+        thread.start()
+      served_before_swaps = len(b_outputs)
+      for generation in range(2, 7):
+        new_params = {"w": np.eye(8, dtype=np.float32) * generation}
+        assert front.arena.swap_state("a", new_params,
+                                      learner_step=generation)
+        # A's swap is visible immediately...
+        np.testing.assert_allclose(
+            front.predict("a", ones(1))["y"], float(generation))
+      time.sleep(0.1)
+      stop.set()
+      for thread in threads:
+        thread.join(timeout=30)
+
+      assert not b_errors, b_errors[:1]
+      # B kept serving THROUGH the swaps (not just before/after).
+      assert len(b_outputs) > served_before_swaps + 5
+      assert all(value == 100.0 for value in b_outputs)
+      # Zero recompiles anywhere: swaps keep shapes, buckets stay hot.
+      assert engine_lib.compile_count() == compiles_before

@@ -504,6 +504,103 @@ class TestPrometheusAdapter:
       assert name_re.match(line), line
     assert "t2r_fleet_actor_0_steps_total 1.0" in body
 
+  def _parse_exposition(self, body):
+    """Minimal text-format (0.0.4) parser: returns
+    ({family: type}, [(name, labels_dict, value)]). The unit tests run
+    the rendered body through THIS instead of grepping lines, so label
+    syntax and family grouping are checked structurally."""
+    import re as _re
+
+    line_re = _re.compile(
+        r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{([^}]*)\})?\s+(\S+)$")
+    label_re = _re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|'
+                           r'\\.)*)"')
+    types = {}
+    samples = []
+    for line in body.splitlines():
+      if not line:
+        continue
+      if line.startswith("# TYPE "):
+        _, _, family, kind = line.split(" ")
+        assert family not in types, f"duplicate TYPE for {family}"
+        types[family] = kind
+        continue
+      if line.startswith("#"):
+        continue
+      match = line_re.match(line)
+      assert match, f"unparseable sample line: {line!r}"
+      name, raw_labels, value = match.groups()
+      labels = dict(label_re.findall(raw_labels or ""))
+      samples.append((name, labels, float(value)))
+    return types, samples
+
+  def test_tenant_prefixes_render_as_labels(self):
+    """ISSUE 13 satellite: `serving.<tenant>.*` metrics become ONE
+    family per metric with a `tenant=` label; reserved serving
+    namespaces (arena/front/admission) stay label-free."""
+    from tensor2robot_tpu.telemetry import prometheus
+
+    tmetrics.counter("serving.robotA.dispatches").inc(4)
+    tmetrics.counter("serving.robotB.dispatches").inc(9)
+    tmetrics.counter("serving.robotA.admission.dropped").inc(2)
+    tmetrics.counter("serving.arena.loads").inc(3)
+    tmetrics.counter("serving.dispatches").inc(13)  # front-wide total
+    hist_bounds = (1.0, 10.0)
+    tmetrics.histogram("serving.robotA.bucket_8_ms",
+                       bounds=hist_bounds).observe(0.5)
+    tmetrics.histogram("serving.robotB.bucket_8_ms",
+                       bounds=hist_bounds).observe(5.0)
+    tmetrics.gauge("serving.robotA.queue_depth").set(2.0)
+
+    body = prometheus.render_text()
+    types, samples = self._parse_exposition(body)
+
+    def sample(name, **labels):
+      rows = [value for n, l, value in samples
+              if n == name and l == labels]
+      assert len(rows) == 1, (name, labels, rows)
+      return rows[0]
+
+    # One family, two tenant series + the unlabeled front-wide total.
+    assert types["t2r_serving_dispatches_total"] == "counter"
+    assert sample("t2r_serving_dispatches_total", tenant="robotA") == 4
+    assert sample("t2r_serving_dispatches_total", tenant="robotB") == 9
+    assert sample("t2r_serving_dispatches_total") == 13
+    # Nested tenant namespaces keep their tail.
+    assert sample("t2r_serving_admission_dropped_total",
+                  tenant="robotA") == 2
+    # Reserved namespace: a POOL metric, not a tenant called "arena".
+    assert sample("t2r_serving_arena_loads_total") == 3
+    assert not [l for n, l, _ in samples
+                if n == "t2r_serving_arena_loads_total" and l]
+    # Gauges carry the label too.
+    assert sample("t2r_serving_queue_depth", tenant="robotA") == 2.0
+    # Histograms: per-tenant bucket series under one family/TYPE.
+    assert types["t2r_serving_bucket_8_ms"] == "histogram"
+    assert sample("t2r_serving_bucket_8_ms_bucket",
+                  tenant="robotA", le="1.0") == 1
+    assert sample("t2r_serving_bucket_8_ms_bucket",
+                  tenant="robotB", le="1.0") == 0
+    assert sample("t2r_serving_bucket_8_ms_bucket",
+                  tenant="robotB", le="+Inf") == 1
+    assert sample("t2r_serving_bucket_8_ms_count",
+                  tenant="robotA") == 1
+    assert sample("t2r_serving_bucket_8_ms_sum",
+                  tenant="robotB") == 5.0
+
+  def test_two_segment_serving_names_stay_unlabeled(self):
+    # `serving.bucket_8_ms` / `serving.microbatch_rows` (the
+    # single-model engine's names) have no tenant segment and must
+    # render exactly as before the label feature.
+    from tensor2robot_tpu.telemetry import prometheus
+
+    tmetrics.histogram("serving.bucket_8_ms",
+                       bounds=(1.0, 10.0)).observe(0.5)
+    tmetrics.gauge("serving.microbatch_queue_depth").set(1.0)
+    body = prometheus.render_text()
+    assert 't2r_serving_bucket_8_ms_bucket{le="1.0"} 1' in body
+    assert "t2r_serving_microbatch_queue_depth 1.0" in body
+
   def test_http_endpoint_scrapes_live_registry(self):
     import urllib.request
 
