@@ -107,6 +107,19 @@ Usage: python bench.py [--paper] [--profile DIR] [--input] [--replay]
              staleness the learner actually trained on. With
              --dry-run: tiny model, short run, no BENCH_DETAIL.json
              write — the tier-1 smoke.
+  --chaos    the fault-recovery axis (chaos section): the fleet
+             topology under a seeded, deterministic 7-class fault
+             schedule (fleet/faults.py) injected through the REAL
+             rpc/actor/learner seams — actor crash mid-episode, actor
+             hang, learner crash under the resume policy, RPC
+             delay/drop, host stall/forced disconnect — plus an
+             elastic scale_to leg. Commits MTTR per fault class, RPC
+             retry/recovery counters, the per-poll collection-rate
+             spike-and-settle series, and the zero-partial-rows
+             ledger; REFUSES to commit (nonzero exit) if any recovery
+             gate fails. With --dry-run: tiny fleet, same plan and
+             the SAME enforced gates, no BENCH_DETAIL.json write —
+             the tier-1 smoke.
   --envs     the on-device vectorized-env axis (envs section):
              env-steps/s of the Anakin rollout engine (envs/ — CEM
              acting at the committed fleet axis's config) vs num_envs
@@ -1719,6 +1732,369 @@ def bench_fleet(dry_run: bool = False):
   }
 
 
+def bench_chaos(dry_run: bool = False):
+  """The --chaos axis: the fleet topology under a seeded fault
+  schedule, with hard RECOVERY GATES (docs/FLEET.md §"Failure &
+  recovery contract").
+
+  One REAL 2-actor fleet runs a deterministic, digest-stamped
+  `fleet/faults.py` plan covering every fault class, injected through
+  the REAL rpc/actor/learner seams (no mocks): an actor killed
+  MID-EPISODE (staged rows must abort), an actor hung past its
+  heartbeat window (kill-and-respawn), the learner crashed mid-run
+  under `learner_crash_policy="resume"` (the host keeps the store +
+  engine; the respawn restores from the latest checkpoint), RPC
+  requests delayed and dropped client-side (deadline + retry), the
+  host stalled and force-disconnecting server-side — plus an elastic
+  `scale_to(3)` → `scale_to(2)` leg mid-run. The shipped
+  qtopt_fleet_elastic.gin rides through `--validate_only` as the
+  launch gate.
+
+  Committed: MTTR per recovered fault class, the RPC retry/recovery
+  counters + `fleet.recovery_ms` tail, the per-poll collection-rate
+  series (the spike-and-settle view: the rate dips at each fault and
+  recovers), staleness/lag tails, and the zero-partial-rows ledger.
+
+  The bench REFUSES TO COMMIT (raises SystemExit before any detail
+  write — `dry_run` enforces the same gates) unless:
+    * every process-level class recovered with a measured MTTR
+      (actor_crash, actor_hang, learner_crash in `Fleet.recoveries`);
+    * RPC drop/disconnect recovered through the real
+      deadline-and-retry machinery (`fleet.rpc.recovered` >= 2);
+    * every planned fault class shows an injection counter (host
+      registry, pushed role snapshots, the polled series, or the
+      flight record a crashed incarnation dumped at the injection
+      seam — counters a process never lived to push survive there);
+    * `committed_transitions % batch_episodes == 0` AND the
+      mid-episode crash's staged rows were aborted (zero partial
+      episode rows, proven not assumed);
+    * the resumed learner reached the EXACT final step (at most one
+      publish cadence re-trained, zero experience lost) on exactly
+      one resume;
+    * the shutdown barrier leaked nothing (Fleet raises otherwise).
+  """
+  import shutil
+  import tempfile
+  import threading
+
+  from tensor2robot_tpu.fleet import Fleet, FleetConfig
+  from tensor2robot_tpu.fleet import faults
+  from tensor2robot_tpu.telemetry import flightrec
+  from tensor2robot_tpu.telemetry import records as trecords
+
+  tiny = dry_run
+  # Explicit (not generated) schedule: every class, triggers staggered
+  # so each fault lands in a healthy stretch of the run. Counts are in
+  # each class's own unit (batches / learner steps / matching calls).
+  learner_crash_at = 10 if tiny else 150
+  plan = faults.FaultPlan(seed=14, events=(
+      faults.FaultEvent(fault=faults.ACTOR_CRASH, target="actor-0",
+                        at=2, mode="mid_episode"),
+      faults.FaultEvent(fault=faults.ACTOR_HANG, target="actor-1",
+                        at=4, mode="hard",
+                        duration_secs=45.0 if tiny else 90.0),
+      faults.FaultEvent(fault=faults.RPC_DROP, target="actor-1",
+                        at=3, method="act"),
+      faults.FaultEvent(fault=faults.RPC_DELAY, target="learner",
+                        at=6, duration_secs=0.05, count=3),
+      faults.FaultEvent(fault=faults.SLOW_HOST, target="host",
+                        at=8, method="act", duration_secs=0.2,
+                        count=4),
+      faults.FaultEvent(fault=faults.RPC_DISCONNECT, target="host",
+                        at=12, method="commit"),
+      faults.FaultEvent(fault=faults.LEARNER_CRASH, target="learner",
+                        at=learner_crash_at),
+  ))
+  config = FleetConfig(
+      num_actors=2,
+      env="mujoco_pose",
+      image_size=16 if tiny else 32,
+      action_dim=2,
+      torso_filters=(8,) if tiny else (16, 32),
+      head_filters=(8,) if tiny else (32, 32),
+      dense_sizes=(16,) if tiny else (32, 32),
+      cem_population=8 if tiny else 64,
+      cem_iterations=1 if tiny else 2,
+      cem_elites=2 if tiny else 6,
+      batch_size=16 if tiny else 64,
+      # Longer than the no-fault axis: the run must outlive every
+      # detection window AND the learner's checkpoint-restore respawn.
+      max_train_steps=48 if tiny else 360,
+      min_replay_size=32 if tiny else 128,
+      publish_every_steps=8 if tiny else 40,
+      log_every_steps=8 if tiny else 40,
+      batch_episodes=8 if tiny else 16,
+      serve_max_batch=4 if tiny else 8,
+      replay_capacity=512 if tiny else 4096,
+      replay_shards=2,
+      # The chaos policies under test.
+      actor_crash_policy="restart",
+      max_actor_restarts=4,
+      restart_window_secs=600.0,
+      learner_crash_policy="resume",
+      max_learner_restarts=2,
+      actor_heartbeat_timeout_secs=5.0 if tiny else 8.0,
+      heartbeat_timeout_secs=300.0,
+      rpc_call_timeout_secs=3.0 if tiny else 5.0,
+      rpc_max_retries=3,
+      telemetry_poll_secs=1.0,  # the spike-and-settle series cadence
+      fault_plan=plan,
+      launch_timeout_secs=240.0,
+      run_timeout_secs=900.0 if tiny else 1800.0,
+      seed=0)
+  gate_config = os.path.join(
+      os.path.dirname(os.path.abspath(__file__)), "tensor2robot_tpu",
+      "research", "qtopt", "configs", "qtopt_fleet_elastic.gin")
+  model_dir = tempfile.mkdtemp(prefix="t2r_chaos_bench_")
+  scale_events = []
+  try:
+    fleet = Fleet(config, model_dir, gin_configs=(gate_config,))
+    t0 = time.monotonic()
+    fleet.launch()
+
+    def _elastic():
+      # Elastic membership UNDER chaos: grow to 3, shrink back to 2.
+      try:
+        fleet.scale_to(3)
+        time.sleep(3.0 if tiny else 6.0)
+        fleet.scale_to(2)
+      except Exception as e:  # noqa: BLE001 — the gate below catches
+        print(f"elastic leg failed: {e!r}", file=sys.stderr)
+
+    elastic_timer = threading.Timer(4.0 if tiny else 8.0, _elastic)
+    elastic_timer.daemon = True
+    elastic_timer.start()
+    try:
+      fleet.wait()
+    finally:
+      # cancel() only stops an UNFIRED timer; a fired one is a live
+      # thread still scale_to'ing the fleet (Timer IS a Thread) —
+      # join it BEFORE shutdown so the elastic leg never races the
+      # shutdown barrier and always finishes both membership moves.
+      elastic_timer.cancel()
+      elastic_timer.join(timeout=30.0)
+    metrics = fleet.shutdown()
+    wall = time.monotonic() - t0
+    scale_events = list(fleet.scale_events)
+    # The per-poll series BEFORE the tempdir dies: collection rate per
+    # poll window (delta of the host's replay.adds counter) and the
+    # fleet-wide counters each poll captured — including counters of
+    # incarnations that later crashed (the poll is the flight log).
+    series_path = os.path.join(model_dir, "telemetry",
+                               "fleet_metrics.jsonl")
+    poll_records = (trecords.read_records(series_path)
+                    if os.path.exists(series_path) else [])
+    # Flight records: the injector dumps one BEFORE a process-killing
+    # fault fires (faults._record_injection), so a crashed
+    # incarnation's registry counters — which it never lived to push —
+    # survive on disk inside the dump's `metrics` snapshot.
+    flight_dumps = flightrec.read_dumps(
+        os.path.join(model_dir, "flightrec"))
+  finally:
+    shutil.rmtree(model_dir, ignore_errors=True)
+  if metrics is None:
+    raise SystemExit("chaos fleet completed but final metrics were "
+                     "lost; refusing to commit.")
+
+  # ---- evidence assembly ----
+  # `read_records` returns NORMALIZED FLAT records: the envelope's
+  # payload scalars sit at top level next to step/wall/role.
+  meta_keys = ("step", "wall", "role")
+  rate_windows = []
+  series_max: dict = {}
+  last = None
+  for record in poll_records:
+    for key, value in record.items():
+      if key not in meta_keys and isinstance(value, (int, float)):
+        series_max[key] = max(series_max.get(key, 0.0), float(value))
+    adds = record.get("replay.adds")
+    wall_t = record.get("wall")
+    if adds is None or wall_t is None:
+      continue
+    if last is not None and wall_t > last[0]:
+      rate_windows.append((adds - last[1]) / (wall_t - last[0]))
+    last = (wall_t, adds)
+  rate_median = float(np.median(rate_windows)) if rate_windows else 0.0
+  rate_min = min(rate_windows) if rate_windows else 0.0
+  settled_tail = rate_windows[-5:] if rate_windows else []
+  rate_settled = float(np.median(settled_tail)) if settled_tail else 0.0
+
+  def _sources():
+    """One (key, counters) pair per DISTINCT process the run left
+    evidence from: the host registry, each role's final pushed
+    snapshot (the latest incarnation — pushes replace per role), and
+    one flight record per crashed incarnation's pid — an injected
+    crash dies at the seam, so its counters are NEVER pushed; the
+    flight record (dumped at the seam, before death) is their only
+    surviving carrier. The keys are disjoint processes, so SUMS over
+    them never double-count and never miss a crashed incarnation."""
+    host_snap = metrics.get("host_telemetry") or {}
+    yield "host", (host_snap.get("counters") or {})
+    for role, pushed in (metrics.get("pushed_telemetry") or {}).items():
+      yield role, ((pushed.get("snapshot") or {}).get("counters")
+                   or {})
+    for dumped in flight_dumps:
+      role = dumped.get("role") or "?"
+      if role == "orchestrator":
+        continue  # supervisor's own dump shares this process's registry
+      yield (f"{role}#pid{dumped.get('pid')}",
+             (dumped.get("metrics") or {}).get("counters") or {})
+
+  def _counter(name: str) -> float:
+    """Max of a counter over every vantage, the polled series
+    included (did it happen at all? — series keys are `<role>/<name>`
+    for pushed roles, bare for the host's own registry)."""
+    total = max((float(counters.get(name, 0.0))
+                 for _, counters in _sources()), default=0.0)
+    total = max(total, series_max.get(name, 0.0))
+    suffix = f"/{name}"
+    for key, value in series_max.items():
+      if key.endswith(suffix):
+        total = max(total, value)
+    return total
+
+  def _summed(name: str) -> float:
+    """Counter summed over the disjoint per-process sources (rpc
+    counters live in DIFFERENT processes; the polled series is
+    excluded — it re-reads the same registries over time and cannot
+    be summed without double counting)."""
+    return sum(float(counters.get(name, 0.0))
+               for _, counters in _sources())
+
+  injected = {cls: _counter(f"fleet.faults.injected.{cls}")
+              for cls in plan.classes()}
+  recoveries = list(fleet.recoveries)
+  recovered_classes = sorted({r["fault"] for r in recoveries})
+  mttr_ms_by_class: dict = {}
+  for entry in recoveries:
+    mttr_ms_by_class.setdefault(entry["fault"], []).append(
+        entry["mttr_ms"])
+  mttr_ms_by_class = {cls: {"count": len(vals),
+                            "max": round(max(vals), 1),
+                            "mean": round(sum(vals) / len(vals), 1)}
+                      for cls, vals in mttr_ms_by_class.items()}
+  rpc_recovered = _summed("fleet.rpc.recovered")
+  rpc_retries = _summed("fleet.rpc.retries")
+  rpc_timeouts = _summed("fleet.rpc.timeouts")
+  service = metrics.get("service", {})
+  committed = int(service.get("replay_committed_transitions", -1))
+  aborted = int(service.get("replay_aborted_episodes", 0))
+  learner_window = metrics.get("learner_window") or {}
+  cadence = config.publish_every_steps
+  # MEASURED restore point (not config arithmetic): the host is the
+  # one witness with continuous state across learner incarnations —
+  # it records every backward `set_learner_step` as {from_step,
+  # to_step}. Loss = last step the host saw before the crash minus
+  # the step the resumed incarnation restored to.
+  resumes_seen = metrics.get("learner_resumes") or []
+  resume_lost_steps = max(
+      (r["from_step"] - r["to_step"] for r in resumes_seen),
+      default=None)
+
+  # ---- the recovery gates ----
+  gates = {
+      "process_faults_recovered": (
+          set(recovered_classes) >= {"actor_crash", "actor_hang",
+                                     "learner_crash"}),
+      "rpc_faults_recovered": rpc_recovered >= 2,
+      "all_classes_injected": all(v >= 1 for v in injected.values()),
+      "zero_partial_rows": (committed > 0
+                            and committed % config.batch_episodes == 0),
+      "mid_episode_stage_aborted": aborted >= 1,
+      "learner_resumed_to_exact_step": (
+          fleet._learner_restarts == 1
+          and learner_window.get("last_step") == config.max_train_steps
+          and metrics.get("params_learner_step")
+          == config.max_train_steps),
+      "resume_loss_bounded_by_cadence": (
+          len(resumes_seen) == 1
+          and resume_lost_steps is not None
+          and resume_lost_steps <= cadence
+          and resumes_seen[0]["to_step"]
+          >= learner_crash_at - cadence),
+      "elastic_scale_completed": (
+          [e["action"] for e in scale_events]
+          == ["add", "remove"]),
+      "collection_recovered_after_faults": (
+          rate_settled > 0 and rate_median > 0),
+  }
+  if not all(gates.values()):
+    failed = sorted(k for k, ok in gates.items() if not ok)
+    raise SystemExit(
+        f"chaos recovery gates FAILED: {failed}\n"
+        f"injected={injected}\nrecoveries={recoveries}\n"
+        f"rpc_recovered={rpc_recovered} committed={committed} "
+        f"aborted={aborted} learner_window={learner_window} "
+        f"learner_restarts={fleet._learner_restarts} "
+        f"scale_events={scale_events}\n"
+        "refusing to commit.")
+
+  return {
+      "device_kind": jax.devices()[0].device_kind,
+      "host_cores": os.cpu_count(),
+      "fault_plan_digest": plan.digest(),
+      "fault_plan": [e.to_json() for e in plan.events],
+      "gates": {k: bool(v) for k, v in gates.items()},
+      "recoveries": recoveries,
+      "mttr_ms_by_class": mttr_ms_by_class,
+      "injected_by_class": {k: int(v) for k, v in injected.items()},
+      "rpc_recovery": {
+          "recovered": int(rpc_recovered),
+          "retries": int(rpc_retries),
+          "timeouts": int(rpc_timeouts),
+          "recovery_ms_p95_by_role": {
+              role: (pushed.get("snapshot", {}).get("histograms", {})
+                     .get("fleet.recovery_ms", {}).get("p95"))
+              for role, pushed in
+              (metrics.get("pushed_telemetry") or {}).items()
+              if (pushed.get("snapshot", {}).get("histograms", {})
+                  .get("fleet.recovery_ms", {}).get("count"))},
+      },
+      "learner_resume": {
+          "crash_step": learner_crash_at,
+          "publish_cadence": cadence,
+          "measured_restore": resumes_seen,
+          "measured_lost_steps": resume_lost_steps,
+          "resumes": fleet._learner_restarts,
+          "final_step": learner_window.get("last_step"),
+      },
+      "elastic": {"scale_events": scale_events},
+      "zero_partial_rows": {
+          "committed_transitions": committed,
+          "batch_episodes": config.batch_episodes,
+          "remainder": committed % config.batch_episodes,
+          "aborted_episodes": aborted,
+      },
+      "collection_rate": {
+          "windows": len(rate_windows),
+          "poll_secs": config.telemetry_poll_secs,
+          "median_env_steps_per_sec": round(rate_median, 1),
+          "min_env_steps_per_sec": round(rate_min, 1),
+          "settled_env_steps_per_sec": round(rate_settled, 1),
+          "note": ("per-poll delta of the host's replay.adds counter: "
+                   "the spike-and-settle view — the rate dips at each "
+                   "injected fault and settles after recovery"),
+      },
+      "staleness_lag_tail": {
+          "param_refresh_lag": metrics.get("param_refresh_lag"),
+          "staleness": {
+              batch: {k: snap[k] for k in
+                      ("mean_age_steps", "max_age_steps", "rows")
+                      if k in snap}
+              for batch, snap in (metrics.get("staleness") or {}).items()
+              if snap},
+      },
+      "actor_restarts": int(sum(fleet._restarts.values())),
+      "learner_restarts": int(fleet._learner_restarts),
+      "wall_secs": round(wall, 1),
+      "note": (
+          "REAL 2-actor fleet under the seeded fault schedule above: "
+          "every fault injected through the production rpc/actor/"
+          "learner seams, every recovery measured (MTTR = detection "
+          "to first unit of real work), gates enforced before commit"),
+  }
+
+
 def bench_envs(dry_run: bool = False):
   """The --envs axis: on-device vectorized env rollouts (docs/ENVS.md).
 
@@ -2876,6 +3252,25 @@ def main():
         "clean_shutdown": smoke["clean_shutdown"],
     }))
     return
+  if "--chaos" in args and "--dry-run" in args:
+    # Tier-1 smoke of the chaos path: a REAL (tiny) 2-actor fleet
+    # under the full 7-class fault schedule with every recovery gate
+    # ENFORCED (the smoke fails if any class fails to recover, a
+    # partial row lands, or the learner resume misses its step) — NO
+    # detail-file write.
+    smoke = bench_chaos(dry_run=True)
+    print(json.dumps({
+        "chaos_dry_run": "ok",
+        "fault_plan_digest": smoke["fault_plan_digest"][:16],
+        "gates": smoke["gates"],
+        "recovered_classes": sorted(smoke["mttr_ms_by_class"]),
+        "rpc_recovered": smoke["rpc_recovery"]["recovered"],
+        "actor_restarts": smoke["actor_restarts"],
+        "learner_restarts": smoke["learner_restarts"],
+        "zero_partial_remainder":
+            smoke["zero_partial_rows"]["remainder"],
+    }))
+    return
   if "--envs" in args and "--dry-run" in args:
     # Tier-1 smoke of the on-device envs bench path: tiny env/model,
     # the full subprocess topology (virtual mesh, pmap scale-out,
@@ -3003,7 +3398,7 @@ def main():
   axis_flags = {"--input", "--replay", "--replayfeed", "--longcontext",
                 "--podscale", "--moe", "--pipeline", "--verify",
                 "--serving", "--coldstart", "--mxu", "--mfu",
-                "--fleet", "--envs", "--telemetry"}
+                "--fleet", "--envs", "--telemetry", "--chaos"}
   axis_only = (bool(args) and not run_paper and profile_dir is None
                and "--primary" not in args
                and all(a in axis_flags for a in args))
@@ -3095,6 +3490,25 @@ def main():
     detail["serving_multitenant"] = bench_serving_front()
   if "--fleet" in args:
     detail["fleet"] = bench_fleet()
+  if "--chaos" in args:
+    section = bench_chaos()
+    # Env-steps lost: the chaos run's settled/median collection rate
+    # against the committed NO-FAULT fleet axis (the honest "cost of
+    # the fault schedule" once recovery settles, config-matched).
+    fleet_baseline = (detail.get("fleet") or {}).get(
+        "env_steps_per_sec")
+    if fleet_baseline:
+      rate = section["collection_rate"]
+      section["vs_no_fault_baseline"] = {
+          "no_fault_env_steps_per_sec": fleet_baseline,
+          "chaos_median_env_steps_per_sec":
+              rate["median_env_steps_per_sec"],
+          "chaos_settled_env_steps_per_sec":
+              rate["settled_env_steps_per_sec"],
+          "settled_fraction_of_baseline": round(
+              rate["settled_env_steps_per_sec"] / fleet_baseline, 3),
+      }
+    detail["chaos"] = section
   if "--envs" in args:
     section = bench_envs()
     # The ISSUE-9 verdict: on-device rollout vs the committed fleet

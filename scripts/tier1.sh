@@ -67,6 +67,17 @@ echo "--- telemetry smoke (bench.py --telemetry --dry-run; trace merge) ---"
 env JAX_PLATFORMS=cpu python bench.py --telemetry --dry-run
 telemetry_rc=$?
 
+# The chaos smoke is the ISSUE-14 recovery gate: a REAL (tiny)
+# 2-actor fleet runs the full seeded 7-class fault schedule through
+# the production rpc/actor/learner seams — actor crash mid-episode,
+# actor hang, learner crash under the resume policy, RPC drop/delay,
+# host stall/forced disconnect, plus an elastic scale_to leg — and
+# the smoke FAILS unless every class recovers, zero partial rows
+# land, and the resumed learner reaches its exact final step.
+echo "--- chaos smoke (bench.py --chaos --dry-run; recovery gates) ---"
+env JAX_PLATFORMS=cpu python bench.py --chaos --dry-run
+chaos_rc=$?
+
 if [ "$rc" -ne 0 ]; then exit "$rc"; fi
 if [ "$smoke_rc" -ne 0 ]; then exit "$smoke_rc"; fi
 if [ "$coldstart_rc" -ne 0 ]; then exit "$coldstart_rc"; fi
@@ -75,4 +86,5 @@ if [ "$input_rc" -ne 0 ]; then exit "$input_rc"; fi
 if [ "$mfu_rc" -ne 0 ]; then exit "$mfu_rc"; fi
 if [ "$fleet_rc" -ne 0 ]; then exit "$fleet_rc"; fi
 if [ "$envs_rc" -ne 0 ]; then exit "$envs_rc"; fi
-exit "$telemetry_rc"
+if [ "$telemetry_rc" -ne 0 ]; then exit "$telemetry_rc"; fi
+exit "$chaos_rc"

@@ -37,6 +37,10 @@ WORKER_SAFE_MODULES = (
     "tensor2robot_tpu.fleet.rpc",
     "tensor2robot_tpu.fleet.proc",
     "tensor2robot_tpu.fleet.actor",
+    # ISSUE 14: the fault-injection plan rides inside FleetConfig to
+    # every child, actors included — the chaos rig must never drag an
+    # XLA runtime into a jax-free actor.
+    "tensor2robot_tpu.fleet.faults",
     "tensor2robot_tpu.research.qtopt.actor",
     "tensor2robot_tpu.research.pose_env.grasp_bandit",
     # ISSUE 11: the telemetry plane records in actor/worker processes

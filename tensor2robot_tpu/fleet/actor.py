@@ -35,6 +35,7 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from tensor2robot_tpu import telemetry
+from tensor2robot_tpu.fleet import faults as faults_lib
 from tensor2robot_tpu.fleet import proc
 from tensor2robot_tpu.fleet.rpc import RpcClient
 from tensor2robot_tpu.telemetry import flightrec
@@ -178,7 +179,15 @@ def actor_main(config, actor_index: int, address, stop_event,
   telemetry.configure(
       actor_id, trace_dir=getattr(config, "telemetry_dir", "") or None,
       actor_id=actor_id)
-  client = RpcClient(tuple(address), authkey=config.authkey)
+  # The fault-plan seam (ISSUE 14): non-recurring events fire only in
+  # incarnation 0, so a respawned actor replays a fault-free schedule.
+  # `install` also arms the RPC client-side seam for this process.
+  injector = faults_lib.install(config, actor_id,
+                                incarnation=incarnation)
+  client = RpcClient(
+      tuple(address), authkey=config.authkey,
+      call_timeout_secs=config.rpc_call_timeout_secs,
+      max_retries=config.rpc_max_retries)
   try:
     t_before = time.monotonic()
     hello = client.call("hello")
@@ -231,6 +240,18 @@ def actor_main(config, actor_index: int, address, stop_event,
       episodes.set(actor.episodes_collected)
       dropped.set(actor.episodes_dropped)
       batches += 1
+      # Fault-plan seam, consulted BETWEEN batches and BEFORE the
+      # beat: an injected hang leaves the heartbeat one full batch
+      # stale (exactly what a wedged env binding looks like), and an
+      # injected crash dies with the batch committed — partial rows
+      # can only come from the mid_episode mode, whose staged rows the
+      # host aborts on disconnect.
+      event = injector.on_batch(batches)
+      if event is not None:
+        if event.fault == faults_lib.ACTOR_HANG:
+          proc.hang(event.duration_secs)
+        else:
+          _inject_crash(event.mode, sink)
       proc.beat(heartbeat)
       if (push_period is not None
           and time.monotonic() - t_last_push >= push_period):
@@ -238,6 +259,11 @@ def actor_main(config, actor_index: int, address, stop_event,
         _push_telemetry(client, actor_id)
       if crash_after is not None and batches >= crash_after:
         _inject_crash(config.actor_crash_mode, sink)
+    if push_period is not None:
+      # Final snapshot as the actor drains: the orchestrator's
+      # end-of-run telemetry read (shutdown barrier) must see this
+      # incarnation's rpc retry/recovery counters.
+      _push_telemetry(client, actor_id)
     log.info("actor %s stopping cleanly: %d committed / %d dropped "
              "episodes, last policy version %s", actor_id,
              actor.episodes_collected, actor.episodes_dropped,

@@ -44,6 +44,7 @@ from typing import Any, Dict, Optional, Tuple
 import numpy as np
 
 from tensor2robot_tpu import telemetry
+from tensor2robot_tpu.fleet import faults as faults_lib
 from tensor2robot_tpu.fleet import proc
 from tensor2robot_tpu.fleet import rpc as rpc_lib
 from tensor2robot_tpu.telemetry import flightrec
@@ -146,6 +147,7 @@ class _HostState:
     self.publishes = 0
     self._publish_t0: Optional[float] = None
     self._learner_window: Optional[Tuple[float, int, float, int]] = None
+    self._resumes: list = []  # observed backward learner steps
     self._commit_window: Optional[Tuple[float, float]] = None
     self.shutdown_requested = threading.Event()
 
@@ -237,7 +239,15 @@ class _HostState:
         if self._learner_window is None:
           self._learner_window = (now, step, now, step)
         else:
-          t0, s0, _, _ = self._learner_window
+          t0, s0, _, last = self._learner_window
+          if step < last:
+            # The learner's step went BACKWARD: a crash-resume
+            # restored from a checkpoint. The host is the one witness
+            # with continuous state across learner incarnations, so
+            # the MEASURED restore point is recorded here — the chaos
+            # bench's loss-bounded-by-cadence gate reads it instead
+            # of trusting config arithmetic.
+            self._resumes.append({"from_step": last, "to_step": step})
           self._learner_window = (t0, s0, now, step)
       return True
     if method == "publish":
@@ -312,6 +322,7 @@ class _HostState:
   def metrics(self) -> Dict[str, Any]:
     with self._lock:
       learner_window = self._learner_window
+      resumes = list(self._resumes)
       commit_window = self._commit_window
       samplers = list(self._samplers.items())
       publishes = self.publishes
@@ -333,6 +344,7 @@ class _HostState:
             "last_time": learner_window[2],
             "last_step": learner_window[3],
         }),
+        "learner_resumes": resumes,
         "commit_window": (None if commit_window is None else {
             "first_time": commit_window[0],
             "last_time": commit_window[1],
@@ -384,6 +396,10 @@ def host_main(config, ready_conn, stop_event, heartbeat) -> None:
   shutdown barrier). The RPC `shutdown` method is the other exit.
   """
   proc.scrub_inherited_distributed_env()
+  # Server-side fault seam (slow_host stalls, injected disconnects):
+  # armed BEFORE the server accepts, so call counting is deterministic
+  # from the first RPC.
+  faults_lib.install(config, "host")
   try:
     state = _HostState(config)
     server = rpc_lib.RpcServer(state.handle, authkey=config.authkey)

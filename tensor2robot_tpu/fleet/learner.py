@@ -28,6 +28,7 @@ import time
 from typing import Any, Dict, Iterator, Optional
 
 from tensor2robot_tpu import telemetry
+from tensor2robot_tpu.fleet import faults as faults_lib
 from tensor2robot_tpu.fleet import proc
 from tensor2robot_tpu.fleet.rpc import RpcClient
 from tensor2robot_tpu.hooks.hook import Hook
@@ -145,21 +146,55 @@ class _CrashAfterHook(Hook):
           "(FleetConfig.learner_crash_after_steps)")
 
 
+class _FaultPlanHook(Hook):
+  """The learner's fault-plan seam: `on_step` after every train step.
+
+  A due `learner_crash` raises out of the train loop — the same except
+  path a real crash takes (flight record in `learner_main`, exit code
+  seen by the orchestrator, `resume` policy respawns from the latest
+  checkpoint)."""
+
+  def __init__(self, injector: faults_lib.FaultInjector):
+    self._injector = injector
+
+  def after_step(self, step: int, metrics) -> None:
+    event = self._injector.on_step(step)
+    if event is not None:
+      raise RuntimeError(
+          f"injected learner crash (fault plan, step {step})")
+
+
 def learner_main(config, model_dir: str, address, heartbeat,
-                 coordinator_address: Optional[str] = None) -> None:
-  """Child-process entry: connect → train_qtopt → clean exit."""
+                 coordinator_address: Optional[str] = None,
+                 incarnation: int = 0) -> None:
+  """Child-process entry: connect → train_qtopt → clean exit.
+
+  ``incarnation`` > 0 is the `learner_crash_policy="resume"` respawn:
+  `train_qtopt` restores from the latest checkpoint in `model_dir`
+  (the host kept the replay store and serving engine alive), and
+  non-recurring planned faults do not re-fire.
+  """
   proc.scrub_inherited_distributed_env()
   telemetry.configure(
       "learner",
       trace_dir=getattr(config, "telemetry_dir", "") or None)
+  injector = faults_lib.install(config, "learner",
+                                incarnation=incarnation)
+  if incarnation:
+    log.warning("learner incarnation %d: resuming from the latest "
+                "checkpoint in %s", incarnation, model_dir)
   if config.distributed_learner and coordinator_address:
     # The orchestrator picked this address with
     # ephemeral_coordinator_address(); adopt it before any jax use so
     # concurrent fleets on one host never race on a fixed port.
     proc.adopt_coordinator(coordinator_address)
 
-  control = RpcClient(tuple(address), authkey=config.authkey)
-  stream = RpcClient(tuple(address), authkey=config.authkey)
+  rpc_kwargs = dict(
+      authkey=config.authkey,
+      call_timeout_secs=config.rpc_call_timeout_secs,
+      max_retries=config.rpc_max_retries)
+  control = RpcClient(tuple(address), **rpc_kwargs)
+  stream = RpcClient(tuple(address), **rpc_kwargs)
   try:
     from tensor2robot_tpu.parallel.distributed import (
         maybe_initialize_distributed,
@@ -183,6 +218,8 @@ def learner_main(config, model_dir: str, address, heartbeat,
         _HeartbeatHook(heartbeat)]
     if config.learner_crash_after_steps:
       hooks.append(_CrashAfterHook(config.learner_crash_after_steps))
+    if injector.active:
+      hooks.append(_FaultPlanHook(injector))
     train_qtopt(
         learner=_build_learner(config),
         model_dir=model_dir,

@@ -39,6 +39,7 @@ Lifecycle contract (docs/FLEET.md):
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import json
 import logging
@@ -47,11 +48,13 @@ import os
 import secrets
 import subprocess
 import sys
+import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from tensor2robot_tpu import config as gin
 from tensor2robot_tpu.fleet import actor as actor_lib
+from tensor2robot_tpu.fleet import faults as faults_lib
 from tensor2robot_tpu.fleet import host as host_lib
 from tensor2robot_tpu.fleet import learner as learner_lib
 from tensor2robot_tpu.fleet.rpc import RpcClient
@@ -64,6 +67,7 @@ log = logging.getLogger(__name__)
 
 _ENVS = ("toy_grasp", "pose", "mujoco_pose")
 _CRASH_POLICIES = ("restart", "abort")
+_LEARNER_CRASH_POLICIES = ("fatal", "resume")
 _CRASH_MODES = ("raise", "hard", "mid_episode")
 _OVERFLOW = ("drop", "block")
 
@@ -109,26 +113,53 @@ class FleetConfig:
   # Serving plane.
   serve_max_batch: int = 8
   serve_max_wait_us: int = 200
-  # Lifecycle.
+  # Lifecycle. The restart budget is RATE-based (ISSUE 14): a crashed
+  # actor may be respawned up to `max_actor_restarts` times per
+  # `restart_window_secs` sliding window — a crash-loop trips the
+  # budget in minutes while a long-lived fleet absorbs unbounded
+  # occasional churn (restart_window_secs=0 restores the lifetime cap).
   actor_crash_policy: str = "restart"
   max_actor_restarts: int = 3
+  restart_window_secs: float = 600.0
+  # "fatal" (default): learner death takes the fleet down. "resume":
+  # the learner is respawned and `train_qtopt` resumes from the latest
+  # checkpoint in model_dir while the HOST keeps the replay store and
+  # serving engine alive — at most one publish cadence of training
+  # progress is lost, and no collected experience at all.
+  learner_crash_policy: str = "fatal"
+  max_learner_restarts: int = 2
   heartbeat_timeout_secs: float = 300.0  # 0 disables hang detection
+  # Actor hang detection cadence (actors beat per collect batch, so a
+  # much tighter bound than the learner's compile-warmup-tolerant
+  # global timeout is safe). 0 = use heartbeat_timeout_secs.
+  actor_heartbeat_timeout_secs: float = 0.0
   launch_timeout_secs: float = 240.0
   run_timeout_secs: float = 1800.0
   distributed_learner: bool = False
   seed: int = 0
   authkey: bytes = b""  # per-fleet key generated at Fleet construction
+  # RPC deadline/retry envelope for the DATA-PLANE clients (actor +
+  # both learner clients, rpc.RpcClient): per-call reply deadline +
+  # reconnect-and-retry. The orchestrator's control channel takes the
+  # deadline but stays single-shot (retry would stall supervision).
+  rpc_call_timeout_secs: float = 120.0
+  rpc_max_retries: int = 2
   # Telemetry plane (docs/OBSERVABILITY.md). Empty = derived from the
   # fleet's model_dir at launch (<model_dir>/telemetry, /flightrec);
   # telemetry_dir="off" disables cross-process tracing entirely.
   telemetry_dir: str = ""
   flightrec_dir: str = ""
   telemetry_poll_secs: float = 10.0  # 0 disables the aggregated poll
-  # Fault injection (tests / bench failure-path rehearsal).
+  # Fault injection (tests / bench failure-path rehearsal). The
+  # legacy single-fault knobs remain; `fault_plan` is the ISSUE-14
+  # deterministic schedule (faults.FaultPlan — picklable, shipped to
+  # every child, each role injects its own events through the
+  # rpc/actor/learner seams).
   actor_crash_after_episodes: Optional[int] = None
   actor_crash_mode: str = "raise"
   crash_actor_index: int = 0
   learner_crash_after_steps: Optional[int] = None
+  fault_plan: Optional[Any] = None
 
   def __post_init__(self):
     if not self.authkey:
@@ -150,9 +181,19 @@ class FleetConfig:
       raise ValueError(
           f"actor_crash_mode must be one of {_CRASH_MODES}, got "
           f"{self.actor_crash_mode!r}")
+    if self.learner_crash_policy not in _LEARNER_CRASH_POLICIES:
+      raise ValueError(
+          f"learner_crash_policy must be one of "
+          f"{_LEARNER_CRASH_POLICIES}, got "
+          f"{self.learner_crash_policy!r}")
     if self.overflow not in _OVERFLOW:
       raise ValueError(
           f"overflow must be one of {_OVERFLOW}, got {self.overflow!r}")
+    if self.fault_plan is not None and not isinstance(
+        self.fault_plan, faults_lib.FaultPlan):
+      raise ValueError(
+          f"fault_plan must be a faults.FaultPlan, got "
+          f"{type(self.fault_plan).__name__}")
 
 
 @dataclasses.dataclass
@@ -169,6 +210,15 @@ class FleetResult:
   wall_secs: float
   clean_shutdown: bool
   metrics: Dict[str, Any]
+  # Recovery accounting (ISSUE 14): one record per supervised fault
+  # the orchestrator detected AND recovered from ({fault, target,
+  # mttr_ms, ...}); learner respawns under the resume policy; elastic
+  # membership changes ({action, index, t}).
+  recoveries: List[Dict[str, Any]] = dataclasses.field(
+      default_factory=list)
+  learner_restarts: int = 0
+  scale_events: List[Dict[str, Any]] = dataclasses.field(
+      default_factory=list)
 
 
 class Fleet:
@@ -183,17 +233,35 @@ class Fleet:
     self.model_dir = model_dir
     self.gin_configs = tuple(gin_configs)
     self._ctx = mp.get_context("spawn")
-    # Two stop signals on purpose: `_stop` drains the ACTORS, while
-    # the host has its own — it must outlive the actor/learner drain
-    # so the final metrics read has someone to talk to.
-    self._stop = self._ctx.Event()
+    # Stop signals: the host has its own (it must outlive the
+    # actor/learner drain so the final metrics read has someone to
+    # talk to), and every actor gets a PER-ACTOR event so elastic
+    # scale-down can drain one actor without touching the rest
+    # (`scale_to`); the shutdown barrier drains the whole fleet by
+    # setting every per-actor event under `_scale_lock`.
     self._host_stop = self._ctx.Event()
     self._host: Optional[mp.Process] = None
     self._learner: Optional[mp.Process] = None
     self._actors: Dict[int, mp.Process] = {}
+    self._actor_stops: Dict[int, Any] = {}
+    self._draining: List[Tuple[int, mp.Process]] = []
     self._heartbeats: Dict[str, Any] = {}
     self._spawned_at: Dict[str, float] = {}
     self._restarts: Dict[int, int] = {}
+    # Sliding-window restart stamps per target name — the RATE-based
+    # budget (restarts per restart_window_secs, not per lifetime).
+    self._restart_times: Dict[str, Any] = {}
+    self._learner_restarts = 0
+    # In-flight recoveries: detected faults whose respawned process
+    # has not yet stamped a heartbeat. Completed ones move to
+    # `recoveries` with their measured MTTR.
+    self._pending_recoveries: List[Dict[str, Any]] = []
+    self.recoveries: List[Dict[str, Any]] = []
+    self.scale_events: List[Dict[str, Any]] = []
+    # Guards actor-membership mutations: scale_to() may be called
+    # from another thread while wait() supervises.
+    self._scale_lock = threading.RLock()
+    self._next_actor_index = config.num_actors
     self._control: Optional[RpcClient] = None
     self._address: Optional[Tuple[str, int]] = None
     self._error: Optional[BaseException] = None
@@ -229,13 +297,31 @@ class Fleet:
   def _spawn_actor(self, index: int, incarnation: int) -> None:
     name = f"t2r-fleet-actor-{index}"
     heartbeat = self._heartbeat(name)
+    stop = self._actor_stops.get(index)
+    if stop is None:
+      stop = self._actor_stops[index] = self._ctx.Event()
     process = self._ctx.Process(
         target=actor_lib.actor_main,
-        args=(self._run_config, index, self._address, self._stop,
+        args=(self._run_config, index, self._address, stop,
               heartbeat, incarnation),
         name=name, daemon=True)
     process.start()
     self._actors[index] = process
+
+  def _spawn_learner(self, incarnation: int = 0) -> None:
+    coordinator_address = None
+    if self._run_config.distributed_learner:
+      from tensor2robot_tpu.parallel.distributed import (
+          ephemeral_coordinator_address,
+      )
+      coordinator_address = ephemeral_coordinator_address()
+    self._learner = self._ctx.Process(
+        target=learner_lib.learner_main,
+        args=(self._run_config, self.model_dir, self._address,
+              self._heartbeat("t2r-fleet-learner"), coordinator_address,
+              incarnation),
+        name="t2r-fleet-learner", daemon=True)
+    self._learner.start()
 
   def launch(self) -> None:
     """Gate → host (handshake) → actors → learner."""
@@ -296,22 +382,21 @@ class Fleet:
       raise self._error from None
     parent_conn.close()
     self._address = tuple(info["address"])
-    self._control = RpcClient(self._address, authkey=config.authkey)
+    # The control channel rides the DEADLINE half of the envelope
+    # only: every control call sits on a latency-bounded path (the
+    # supervision loop, the shutdown barrier, forensics) with its own
+    # poisoned-connection recovery, and a transparent
+    # reconnect-and-retry would multiply a wedged host's stall by
+    # (retries+1) — freezing hang detection for exactly the window
+    # the chaos MTTR gates measure. Data-plane clients keep retries.
+    self._control = RpcClient(
+        self._address, authkey=config.authkey,
+        call_timeout_secs=config.rpc_call_timeout_secs,
+        max_retries=0)
     for index in range(config.num_actors):
       self._restarts[index] = 0
       self._spawn_actor(index, incarnation=0)
-    coordinator_address = None
-    if config.distributed_learner:
-      from tensor2robot_tpu.parallel.distributed import (
-          ephemeral_coordinator_address,
-      )
-      coordinator_address = ephemeral_coordinator_address()
-    self._learner = self._ctx.Process(
-        target=learner_lib.learner_main,
-        args=(config, self.model_dir, self._address,
-              self._heartbeat("t2r-fleet-learner"), coordinator_address),
-        name="t2r-fleet-learner", daemon=True)
-    self._learner.start()
+    self._spawn_learner(incarnation=0)
     self._launched = True
     self._t_launched = time.monotonic()
     if self._tracer is not None:
@@ -326,27 +411,161 @@ class Fleet:
     if self._error is None:
       self._error = error
 
-  def _check_heartbeats(self) -> None:
-    timeout = self.config.heartbeat_timeout_secs
-    if not timeout:
+  # ---- the rate-based restart budget ----
+
+  def _budget_ok(self, target: str) -> bool:
+    """True while `target` has budget left in the SLIDING restart
+    window (restarts per `restart_window_secs`, not per lifetime —
+    window 0 restores the lifetime cap). Expired stamps are pruned
+    here, so a long-lived fleet absorbs occasional churn forever
+    while a crash-loop trips the budget within one window."""
+    window = self.config.restart_window_secs
+    limit = (self.config.max_learner_restarts if target == "learner"
+             else self.config.max_actor_restarts)
+    stamps = self._restart_times.setdefault(
+        target, collections.deque())
+    if window:
+      now = time.monotonic()
+      while stamps and now - stamps[0] > window:
+        stamps.popleft()
+    return len(stamps) < limit
+
+  def _charge_restart(self, target: str) -> None:
+    self._restart_times.setdefault(
+        target, collections.deque()).append(time.monotonic())
+
+  # ---- fault recovery ----
+
+  def _begin_recovery(self, fault: str, target: str, name: str,
+                      **detail: Any) -> None:
+    """Registers an in-flight recovery: the respawned process named
+    `name` completes it by stamping its heartbeat (its first unit of
+    real work — an actor's first collect batch, the learner's first
+    resumed train step), which is when MTTR honestly ends."""
+    if self._tracer is not None:
+      self._tracer.event("fleet.fault_detected", fault=fault,
+                         target=target, **detail)
+    self._pending_recoveries.append({
+        "fault": fault, "target": target,
+        "t_detected": detail.pop("t_detected"),
+        "t_respawned": time.monotonic(),
+        "heartbeat": self._heartbeats[name],
+        "detail": detail})
+
+  def _complete_recoveries(self) -> None:
+    still: List[Dict[str, Any]] = []
+    for pending in self._pending_recoveries:
+      stamped = pending["heartbeat"].value
+      if stamped <= pending["t_respawned"]:
+        still.append(pending)
+        continue
+      mttr_ms = (stamped - pending["t_detected"]) * 1e3
+      entry = {"fault": pending["fault"], "target": pending["target"],
+               "mttr_ms": round(mttr_ms, 1)}
+      entry.update(pending["detail"])
+      self.recoveries.append(entry)
+      # The recovery histogram every chaos dashboard keys on
+      # (docs/OBSERVABILITY.md); RPC-level recoveries observe the
+      # same name from their own processes.
+      faults_lib.recovery_histogram().observe(mttr_ms)
+      if self._tracer is not None:
+        self._tracer.event("fleet.recovered", **entry)
+      log.warning("fleet recovered from %s (%s): MTTR %.0f ms",
+                  pending["fault"], pending["target"], mttr_ms)
+    self._pending_recoveries = still
+
+  def _handle_actor_failure(self, index: int, fault: str,
+                            t_detected: Optional[float] = None,
+                            **detail: Any) -> None:
+    """One dead/hung actor: respawn under the rate budget, or raise.
+
+    ``t_detected`` is when the fault was DETECTED — callers whose
+    handling itself takes time (the hang path's terminate/join
+    escalation) pass the stamp they took at detection so MTTR never
+    excludes the kill latency; None = detection is now (the exit-code
+    poll path, where detection and handling coincide)."""
+    target = f"actor-{index}"
+    if (self.config.actor_crash_policy == "restart"
+        and self._budget_ok(target)):
+      self._restarts[index] += 1
+      self._charge_restart(target)
+      log.warning(
+          "actor %d failed (%s %s); restart %d (budget %d per "
+          "%.0fs window) — session will reopen with "
+          "abort-of-staged-rows", index, fault, detail,
+          self._restarts[index], self.config.max_actor_restarts,
+          self.config.restart_window_secs)
+      if t_detected is None:
+        t_detected = time.monotonic()
+      self._spawn_actor(index, incarnation=self._restarts[index])
+      self._begin_recovery(fault, target, f"t2r-fleet-actor-{index}",
+                           t_detected=t_detected, **detail)
       return
+    raise FleetError(
+        f"actor {index} died ({fault}, {detail}) under "
+        f"policy={self.config.actor_crash_policy!r} after "
+        f"{self._restarts[index]} restart(s) — restart budget "
+        f"({self.config.max_actor_restarts} per "
+        f"{self.config.restart_window_secs:.0f}s window) exhausted"
+        if self.config.actor_crash_policy == "restart" else
+        f"actor {index} died ({fault}, {detail}) under "
+        f"policy={self.config.actor_crash_policy!r}")
+
+  def _check_heartbeats(self) -> None:
+    """Hang detection. A stale ACTOR heartbeat is a recoverable fault
+    under the restart policy (kill-and-respawn, the `actor_hang`
+    class); a stale learner/host heartbeat stays fatal — a hung
+    learner holds the training lease and a hung host IS the fleet."""
+    global_timeout = self.config.heartbeat_timeout_secs
+    actor_timeout = (self.config.actor_heartbeat_timeout_secs
+                     or global_timeout)
     now = time.monotonic()
-    for name, value in self._heartbeats.items():
+    for name, value in list(self._heartbeats.items()):
+      is_actor = name.startswith("t2r-fleet-actor-")
+      timeout = actor_timeout if is_actor else global_timeout
+      if not timeout:
+        continue
       last = max(value.value, self._spawned_at.get(name, 0.0))
-      if now - last > timeout:
-        raise FleetError(
-            f"{name} heartbeat stale for {now - last:.0f}s "
-            f"(> {timeout:.0f}s): process hung")
+      stale = now - last
+      if stale <= timeout:
+        continue
+      if is_actor and self.config.actor_crash_policy == "restart":
+        index = int(name.rsplit("-", 1)[1])
+        process = self._actors.get(index)
+        if process is None:
+          continue  # drained by a concurrent scale_down
+        log.warning("actor %d heartbeat stale for %.0fs; killing the "
+                    "hung process for respawn", index, stale)
+        # MTTR starts HERE, at detection: a SIGTERM-masking hang pays
+        # up to two 5s joins below, and that kill latency is part of
+        # the outage the fleet experienced.
+        t_detected = time.monotonic()
+        process.terminate()
+        process.join(timeout=5.0)
+        if process.is_alive():
+          process.kill()
+          process.join(timeout=5.0)
+        self._handle_actor_failure(index, faults_lib.ACTOR_HANG,
+                                   t_detected=t_detected,
+                                   stale_secs=round(stale, 1))
+        continue
+      raise FleetError(
+          f"{name} heartbeat stale for {stale:.0f}s "
+          f"(> {timeout:.0f}s): process hung")
 
   def _fresh_control(self) -> Optional[RpcClient]:
     """A new control-channel client (a timed-out call poisons the old
-    one — rpc.py contract); None when the host is unreachable."""
+    one — rpc.py contract); None when the host is unreachable.
+    Single-shot like the launch-time client: control calls must stay
+    latency-bounded (see the `max_retries=0` rationale at launch)."""
     if self._address is None:
       return None
     try:
-      return RpcClient(self._address,
-                       authkey=self._run_config.authkey,
-                       connect_timeout_secs=10.0)
+      return RpcClient(
+          self._address, authkey=self._run_config.authkey,
+          connect_timeout_secs=10.0,
+          call_timeout_secs=self._run_config.rpc_call_timeout_secs,
+          max_retries=0)
     except Exception:  # noqa: BLE001
       log.warning("control-channel reconnect failed", exc_info=True)
       return None
@@ -428,37 +647,114 @@ class Fleet:
         self._control.close()
         self._control = None
 
+  def _reap_draining(self) -> None:
+    """Scale-down drains finish asynchronously; a drained actor's exit
+    (any code — it was leaving) must never read as a crash."""
+    still: List[Tuple[int, mp.Process]] = []
+    for index, process in self._draining:
+      if process.exitcode is None:
+        still.append((index, process))
+      elif process.exitcode != 0:
+        log.warning("drained actor %d exited %s", index,
+                    process.exitcode)
+    self._draining = still
+
   def _supervise_once(self) -> bool:
     """One poll; returns True when the learner finished cleanly."""
-    learner = self._learner
-    if learner.exitcode is not None:
-      if learner.exitcode == 0:
-        return True
-      raise FleetError(
-          f"learner died (exit {learner.exitcode}); stopping actors")
-    if self._host.exitcode is not None:
-      raise FleetError(
-          f"replay/serving host died (exit {self._host.exitcode})")
-    for index, process in list(self._actors.items()):
-      if process.exitcode is None:
-        continue
-      # Any exit while the fleet is running is a crash (clean actor
-      # exits only happen after the stop event in shutdown).
-      if (self.config.actor_crash_policy == "restart"
-          and self._restarts[index] < self.config.max_actor_restarts):
-        self._restarts[index] += 1
-        log.warning(
-            "actor %d died (exit %s); restart %d/%d — session will "
-            "reopen with abort-of-staged-rows", index, process.exitcode,
-            self._restarts[index], self.config.max_actor_restarts)
-        self._spawn_actor(index, incarnation=self._restarts[index])
-      else:
+    with self._scale_lock:
+      learner = self._learner
+      if learner.exitcode is not None:
+        if learner.exitcode == 0:
+          return True
+        if (self.config.learner_crash_policy == "resume"
+            and self._budget_ok("learner")):
+          # The resume policy (ISSUE 14): respawn the learner — the
+          # HOST stays up with the replay store and serving engine
+          # intact, and `train_qtopt` restores from the latest
+          # checkpoint in model_dir, so at most one publish cadence
+          # of training progress is lost and no experience at all.
+          self._learner_restarts += 1
+          self._charge_restart("learner")
+          log.warning(
+              "learner died (exit %s); resume %d (budget %d per "
+              "%.0fs window) from the latest checkpoint",
+              learner.exitcode, self._learner_restarts,
+              self.config.max_learner_restarts,
+              self.config.restart_window_secs)
+          t_detected = time.monotonic()
+          self._spawn_learner(incarnation=self._learner_restarts)
+          self._begin_recovery(
+              faults_lib.LEARNER_CRASH, "learner",
+              "t2r-fleet-learner", t_detected=t_detected,
+              exitcode=learner.exitcode)
+        else:
+          raise FleetError(
+              f"learner died (exit {learner.exitcode}) under "
+              f"policy={self.config.learner_crash_policy!r} after "
+              f"{self._learner_restarts} resume(s); stopping actors")
+      if self._host.exitcode is not None:
         raise FleetError(
-            f"actor {index} died (exit {process.exitcode}) under "
-            f"policy={self.config.actor_crash_policy!r} after "
-            f"{self._restarts[index]} restart(s)")
-    self._check_heartbeats()
+            f"replay/serving host died (exit {self._host.exitcode})")
+      for index, process in list(self._actors.items()):
+        if process.exitcode is None:
+          continue
+        # Any exit while the fleet is running is a crash (clean actor
+        # exits only happen after a stop event: shutdown or a
+        # scale-down drain, both of which remove the actor first).
+        self._handle_actor_failure(index, faults_lib.ACTOR_CRASH,
+                                   exitcode=process.exitcode)
+      self._reap_draining()
+      self._check_heartbeats()
+      self._complete_recoveries()
     return False
+
+  # ---- elastic membership ----
+
+  def scale_to(self, num_actors: int) -> None:
+    """Elastic actor membership: grow or shrink the fleet MID-RUN.
+
+    Scale-up spawns fresh actors under new indices (each with its own
+    stop event, heartbeat, and restart budget); scale-down sets the
+    highest-indexed actors' PER-ACTOR stop events — each finishes its
+    current collect batch (commits are atomic episodes, so no partial
+    rows can land) and exits, joined asynchronously by supervision.
+    Safe to call from another thread while `wait()` supervises.
+    """
+    if num_actors < 1:
+      raise ValueError(f"num_actors must be >= 1, got {num_actors}")
+    with self._scale_lock:
+      # Checked under the lock shutdown() closes the fleet under: a
+      # scale-up can never slip between the `_closed` flip and the
+      # stop-event broadcast and spawn an actor nothing would stop.
+      if not self._launched or self._closed:
+        raise FleetError("scale_to() needs a launched, open fleet")
+      current = sorted(self._actors)
+      delta = num_actors - len(current)
+      if delta == 0:
+        return
+      now = time.monotonic()
+      if delta > 0:
+        for _ in range(delta):
+          index = self._next_actor_index
+          self._next_actor_index += 1
+          self._restarts[index] = 0
+          self._spawn_actor(index, incarnation=0)
+          self.scale_events.append(
+              {"action": "add", "index": index, "t": now})
+      else:
+        for index in current[delta:]:
+          process = self._actors.pop(index)
+          self._actor_stops.pop(index).set()
+          name = f"t2r-fleet-actor-{index}"
+          self._heartbeats.pop(name, None)
+          self._spawned_at.pop(name, None)
+          self._draining.append((index, process))
+          self.scale_events.append(
+              {"action": "remove", "index": index, "t": now})
+      tmetrics.gauge("fleet.actors").set(len(self._actors))
+      if self._tracer is not None:
+        self._tracer.event("fleet.scaled", actors=len(self._actors))
+      log.info("fleet scaled to %d actors", len(self._actors))
 
   def wait(self) -> None:
     """Blocks until the learner exits cleanly; on any latched failure
@@ -498,6 +794,7 @@ class Fleet:
 
   def _all_processes(self) -> List[mp.Process]:
     procs = list(self._actors.values())
+    procs.extend(process for _, process in self._draining)
     if self._learner is not None:
       procs.append(self._learner)
     if self._host is not None:
@@ -513,11 +810,20 @@ class Fleet:
     survives the barrier — the zero-leak contract is checked, not
     assumed.
     """
-    if self._closed:
-      return None
-    self._closed = True
-    self._stop.set()
-    for index, process in self._actors.items():
+    with self._scale_lock:
+      # `_closed` flips and every stop event is set under the SAME
+      # lock `scale_to` holds while it checks `_closed` and spawns:
+      # a racing scale-up either completes first (its fresh actor's
+      # stop event exists here and gets set) or observes `_closed`
+      # and refuses — no actor can be spawned without a stop signal.
+      if self._closed:
+        return None
+      self._closed = True
+      for stop in self._actor_stops.values():
+        stop.set()
+      actors = list(self._actors.items())
+      draining = list(self._draining)
+    for index, process in actors + draining:
       self._join_or_kill(process, timeout_secs / 2,
                          f"actor {index}")
     metrics = None
@@ -533,6 +839,21 @@ class Fleet:
           metrics = self._control.call("metrics", timeout_secs=30.0)
         except Exception:
           log.warning("final metrics read failed", exc_info=True)
+        else:
+          # The chaos bench's RPC-recovery gates read the
+          # actor/learner registry snapshots (retry/recovery
+          # counters live in THOSE processes); actors push a final
+          # snapshot as they drain, so this read sees them all.
+          try:
+            view = self._control.call("telemetry", timeout_secs=15.0)
+            metrics["pushed_telemetry"] = view.get("pushed")
+            metrics["host_telemetry"] = view.get("host")
+          except Exception:
+            # Poisoned-on-timeout contract: the `shutdown` call below
+            # must not read this call's late reply.
+            log.warning("final telemetry read failed", exc_info=True)
+            self._control.close()
+            self._control = self._fresh_control()
     self._host_stop.set()
     if self._control is not None:
       if self._host is not None and self._host.is_alive():
@@ -575,8 +896,12 @@ class Fleet:
     wall = time.monotonic() - t0
     if metrics is None:
       raise FleetError("fleet completed but final metrics were lost")
-    return _result_from_metrics(metrics, wall, sum(
+    result = _result_from_metrics(metrics, wall, sum(
         self._restarts.values()))
+    result.recoveries = list(self.recoveries)
+    result.learner_restarts = self._learner_restarts
+    result.scale_events = list(self.scale_events)
+    return result
 
 
 def _result_from_metrics(metrics: Dict[str, Any], wall_secs: float,

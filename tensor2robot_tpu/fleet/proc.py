@@ -60,3 +60,16 @@ def beat(heartbeat) -> None:
   """
   if heartbeat is not None:
     heartbeat.value = time.monotonic()
+
+
+def hang(duration_secs: float) -> None:
+  """Deterministic hang injection: sleep WITHOUT beating.
+
+  The `actor_hang` fault class (`fleet/faults.py`): the process stays
+  alive but its heartbeat goes stale, which is exactly what a wedged
+  env binding or a deadlocked native call looks like from the
+  orchestrator — detected by the heartbeat timer, recovered by
+  kill-and-respawn under the restart policy. A real hang would not
+  check a stop event either, so this one doesn't.
+  """
+  time.sleep(duration_secs)

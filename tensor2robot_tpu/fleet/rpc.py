@@ -17,13 +17,31 @@ for its RPC system of choice):
     staged half-episode gets aborted server-side (the session-abort
     crash contract of `replay.service`, extended across the process
     boundary).
-  * `RpcClient` — blocking request/response. NOT thread-safe by
-    design: one owner thread per client. A process that needs RPC
+  * `RpcClient` — blocking request/response with a PER-CALL DEADLINE
+    and exponential-backoff-and-jitter retries (ISSUE 14): every call
+    bounds its wait for the reply (`call_timeout_secs`, default 120s —
+    a half-dead host strands nobody until a heartbeat timer fires),
+    and a timed-out or dropped connection is retried through a fresh
+    connection (session state needs no client-side re-establishment:
+    the host re-creates an actor's session on first use of the new
+    connection, aborting whatever the old one staged — `_session_for`
+    keyed on actor_id). Retries are at-least-once: the replay
+    session-abort contract guarantees a retried commit never lands a
+    PARTIAL episode (a duplicate whole episode is possible and
+    harmless — `adds_total % batch_episodes` stays 0). NOT thread-safe
+    by design: one owner thread per client. A process that needs RPC
     from two threads (the learner's train loop + its prefetch thread)
     opens two clients — loopback connections are cheap, and two
     sockets beat a lock that would serialize a param publish behind a
     slow sample (and trip the CON301 blocking-under-lock rule this
     package is linted with).
+
+Fault-injection seams (`fleet/faults.py`, chaos testing): the module
+holds one process-global injector consulted on every client call
+(delay / drop-the-send) and every server handler turn (stall /
+disconnect). The seams sit in the REAL code paths, so an injected
+drop times out through the same deadline and recovers through the
+same retry machinery a production fault would.
 
 This module must stay importable WITHOUT jax: actor processes import
 it at spawn and never touch a device (tests/test_fleet.py pins the
@@ -33,6 +51,7 @@ jax-free actor import).
 from __future__ import annotations
 
 import logging
+import random
 import threading
 import time
 import traceback
@@ -40,6 +59,7 @@ from multiprocessing.connection import Client, Listener
 from typing import Any, Callable, List, Optional, Tuple
 
 from tensor2robot_tpu import telemetry
+from tensor2robot_tpu.telemetry import metrics as tmetrics
 
 log = logging.getLogger(__name__)
 
@@ -49,6 +69,33 @@ log = logging.getLogger(__name__)
 DEFAULT_AUTHKEY = b"t2r-fleet"
 
 DISCONNECT_METHOD = "__disconnect__"
+
+# Deadline/retry defaults (overridable per client and per call). The
+# default deadline is deliberately generous — it exists to unstrand
+# callers from a dead host, not to police a slow one; latency-critical
+# callers pass tighter per-call values.
+DEFAULT_CALL_TIMEOUT_SECS = 120.0
+DEFAULT_MAX_RETRIES = 2
+_BACKOFF_BASE_SECS = 0.05
+_BACKOFF_MAX_SECS = 2.0
+
+# Process-global fault injector (faults.FaultInjector or None). One
+# per process is the right granularity: a fleet child is either a
+# client-side process (actor/learner) or the host.
+_fault_injector: Optional[Any] = None
+
+
+def set_fault_injector(injector: Optional[Any]) -> None:
+  """Installs (or clears, with None) this process's RPC fault seam."""
+  global _fault_injector
+  _fault_injector = injector
+
+
+def _fault_action(side: str, method: str) -> Optional[Tuple[str, float]]:
+  injector = _fault_injector
+  if injector is None:
+    return None
+  return injector.rpc_action(side, method)
 
 
 class RpcError(RuntimeError):
@@ -105,6 +152,17 @@ class RpcServer:
           method, payload = conn.recv()
         except (EOFError, OSError):
           break
+        # Server-side fault seam (chaos): a stall models a slow host,
+        # a disconnect models a half-dead one — the break runs the
+        # REAL disconnect path below (session abort and all), and the
+        # client recovers through its real reconnect-and-retry.
+        action = _fault_action("server", method)
+        if action is not None:
+          kind, secs = action
+          if kind == "delay":
+            time.sleep(secs)
+          elif kind == "disconnect":
+            break
         try:
           # Every RPC method gets a server-side span for free: the
           # merged timeline shows act/commit/sample handler time per
@@ -160,58 +218,151 @@ class RpcServer:
 
 
 class RpcClient:
-  """Blocking request/response client. One owner thread per instance
-  (see module docstring) — open a second client for a second thread."""
+  """Deadline-bounded request/response client with retry. One owner
+  thread per instance (see module docstring) — open a second client
+  for a second thread."""
 
   def __init__(self,
                address: Tuple[str, int],
                authkey: bytes = DEFAULT_AUTHKEY,
-               connect_timeout_secs: float = 20.0):
-    deadline = time.monotonic() + connect_timeout_secs
-    last_error: Optional[BaseException] = None
+               connect_timeout_secs: float = 20.0,
+               call_timeout_secs: Optional[float] =
+               DEFAULT_CALL_TIMEOUT_SECS,
+               max_retries: int = DEFAULT_MAX_RETRIES):
+    """`call_timeout_secs` is the default per-call reply deadline
+    (None disables — the pre-ISSUE-14 strand-forever behavior, opt-in
+    only); `max_retries` bounds reconnect-and-retry attempts per
+    call. A retried caller needs no session re-establishment: the
+    host rebuilds sessions server-side on first use of the fresh
+    connection (see the module docstring)."""
+    self._address = tuple(address)
+    self._authkey = authkey
+    self._connect_timeout = connect_timeout_secs
+    self._call_timeout = call_timeout_secs
+    self._max_retries = int(max_retries)
+    self.reconnects = 0
     self._conn = None
+    self._connect(connect_timeout_secs)
+
+  def _connect(self, timeout_secs: float) -> None:
+    deadline = time.monotonic() + timeout_secs
+    last_error: Optional[BaseException] = None
     while True:
       try:
-        self._conn = Client(tuple(address), authkey=authkey)
-        break
-      except (ConnectionRefusedError, FileNotFoundError) as e:
-        # The host process may still be warming up its engine; retry
-        # until the connect window closes.
+        self._conn = Client(self._address, authkey=self._authkey)
+        return
+      except (ConnectionRefusedError, FileNotFoundError, OSError) as e:
+        # The host process may still be warming up its engine (or
+        # rebinding after a fault); retry until the window closes.
         last_error = e
         if time.monotonic() > deadline:
           raise TimeoutError(
-              f"fleet rpc: no server at {address} after "
-              f"{connect_timeout_secs:.0f}s") from last_error
+              f"fleet rpc: no server at {self._address} after "
+              f"{timeout_secs:.0f}s") from last_error
         time.sleep(0.05)
 
-  def call(self, method: str, payload: Any = None,
-           timeout_secs: Optional[float] = None) -> Any:
-    """One request/response round trip; raises `RpcError` when the
-    server-side handler raised (its traceback is the message).
+  def call_once(self, method: str, payload: Any = None,
+                timeout_secs: Optional[float] = None) -> Any:
+    """ONE request/response round trip — no retry, no reconnect.
 
-    `timeout_secs` bounds the wait for the REPLY (the orchestrator's
-    shutdown path must not hang on a wedged host); on expiry the
-    client raises `TimeoutError` and the connection should be
-    considered poisoned (an in-flight reply may still arrive).
+    `timeout_secs` bounds the wait for the REPLY (None falls back to
+    the client default; an explicit None default disables). On expiry
+    raises `TimeoutError` and the connection must be considered
+    POISONED (an in-flight reply may still arrive and would be read as
+    the answer to the next call); on a dropped connection raises
+    `ConnectionError`. `RpcError` when the server-side handler raised.
     """
+    timeout = (self._call_timeout if timeout_secs is None
+               else timeout_secs)
     try:
       # Client-side span: the caller's view of the same RPC (queueing
       # + transport + handler), so actor-vs-host wait decomposes in
       # the merged timeline.
       with telemetry.span(f"rpc_call.{method}"):
-        self._conn.send((method, payload))
-        if timeout_secs is not None and not self._conn.poll(
-            timeout_secs):
+        action = _fault_action("client", method)
+        if action is not None:
+          kind, secs = action
+          if kind == "delay":
+            time.sleep(secs)
+            action = None
+        if action is None:
+          # (a "drop" skips the send: the request is lost in flight
+          # and the REAL deadline below fires.)
+          self._conn.send((method, payload))
+        if timeout is not None and not self._conn.poll(timeout):
+          tmetrics.counter("fleet.rpc.timeouts").inc()
           raise TimeoutError(
               f"fleet rpc: no reply to {method!r} in "
-              f"{timeout_secs:.0f}s")
+              f"{timeout:.0f}s")
         status, value = self._conn.recv()
+    except TimeoutError:
+      # Before the broad OSError clause: TimeoutError IS an OSError
+      # subclass, and the deadline must never be rebranded as a
+      # connection drop (callers distinguish the two).
+      raise
     except (EOFError, OSError) as e:
       raise ConnectionError(
           f"fleet rpc: server dropped during {method!r}") from e
     if status == "err":
       raise RpcError(f"remote {method!r} failed:\n{value}")
     return value
+
+  def call(self, method: str, payload: Any = None,
+           timeout_secs: Optional[float] = None,
+           max_retries: Optional[int] = None) -> Any:
+    """Request/response with deadline + reconnect-and-retry.
+
+    A `TimeoutError` or `ConnectionError` closes the (poisoned)
+    connection, backs off exponentially with jitter, reconnects, and
+    resends — up to `max_retries` times, after which the last error
+    is raised. `RpcError` (a server-side handler
+    exception) never retries: the request ARRIVED; re-sending it is
+    the application's decision, not the transport's. Retried commits
+    are at-least-once (see module docstring — partial rows can never
+    land, duplicates are whole episodes).
+    """
+    retries = self._max_retries if max_retries is None else max_retries
+    t_first_failure: Optional[float] = None
+    attempt = 0
+    while True:
+      try:
+        result = self.call_once(method, payload,
+                                timeout_secs=timeout_secs)
+        if t_first_failure is not None:
+          # The call RECOVERED: stamp the end-to-end outage the caller
+          # experienced (first failure → first success) into the
+          # shared recovery histogram next to the process-level MTTRs.
+          from tensor2robot_tpu.fleet import faults
+          recovery_ms = (time.monotonic() - t_first_failure) * 1e3
+          faults.recovery_histogram().observe(recovery_ms)
+          tmetrics.counter("fleet.rpc.recovered").inc()
+          telemetry.event("fleet.rpc_recovered", method=method,
+                          attempts=attempt,
+                          recovery_ms=round(recovery_ms, 1))
+        return result
+      except (TimeoutError, ConnectionError) as e:
+        if t_first_failure is None:
+          t_first_failure = time.monotonic()
+        if attempt >= retries:
+          raise
+        attempt += 1
+        tmetrics.counter("fleet.rpc.retries").inc()
+        log.warning(
+            "fleet rpc: %r failed (%s); retry %d/%d with fresh "
+            "connection", method, e, attempt, retries)
+        # Poisoned-on-timeout contract: never reuse the old socket.
+        try:
+          self._conn.close()
+        except OSError:
+          pass
+        backoff = min(_BACKOFF_MAX_SECS,
+                      _BACKOFF_BASE_SECS * (2 ** (attempt - 1)))
+        # Full jitter: concurrent retriers (every actor saw the same
+        # host stall) must not reconnect in lockstep.
+        time.sleep(backoff * random.random())
+        self._connect(self._connect_timeout)
+        self.reconnects += 1
+        tmetrics.counter("fleet.rpc.reconnects").inc()
 
   def close(self) -> None:
     if self._conn is not None:

@@ -80,12 +80,16 @@ class _Resident:
   """One tenant's residency record: a future that resolves to the
   engine, plus the byte reservation taken while it loads."""
 
-  __slots__ = ("tenant", "future", "bytes")
+  __slots__ = ("tenant", "future", "bytes", "async_pickup_pending")
 
   def __init__(self, tenant: str):
     self.tenant = tenant
     self.future: Future = Future()
     self.bytes = 0
+    # True between an engine_async() cold MISS and the dispatcher's
+    # first post-load re-touch: that re-touch is the tail of the SAME
+    # logical dispatch the miss already counted, not a warm hit.
+    self.async_pickup_pending = False
 
   @property
   def loaded(self) -> bool:
@@ -230,6 +234,56 @@ class ModelArena:
     # Done: returns immediately. Mid-load on another thread: waiting
     # on its future is the "never load the same tenant twice" seam.
     return record.future.result()
+
+  def engine_async(self, tenant: str):
+    """Non-blocking get-or-load: `(engine, None)` on a resident hit
+    (LRU-touched, dict ops only), `(None, future)` when the tenant is
+    cold or mid-load — a cold touch starts the load on a BACKGROUND
+    thread and returns immediately, so a single-threaded caller (the
+    ServingFront dispatcher) is never parked behind a loader while
+    other tenants have dispatchable work (ISSUE 14 satellite). The
+    future resolves to the engine, or to the load's exception."""
+    spec = self.spec(tenant)
+    with self._lock:
+      record = self._resident.get(tenant)
+      if record is not None:
+        # Same ownership rule as engine(): whoever INSTALLS the record
+        # owns its load; everyone else rides the future.
+        self._resident.move_to_end(tenant)
+        hit = record.future.result() if record.loaded else None
+        # The first post-load touch completes the cold dispatch whose
+        # miss was already counted — don't double it as a warm hit
+        # (the sync engine() path counts that dispatch once).
+        count_hit = hit is not None and not record.async_pickup_pending
+        if hit is not None:
+          record.async_pickup_pending = False
+        owner = False
+      else:
+        record = _Resident(tenant)
+        record.async_pickup_pending = True
+        self._resident[tenant] = record
+        hit = None
+        count_hit = False
+        owner = True
+    if owner:
+      self._tm_misses.inc()
+      threading.Thread(
+          target=self._load_quietly, args=(spec, record),
+          name=f"arena-load-{tenant}", daemon=True).start()
+      return None, record.future
+    if hit is not None:
+      if count_hit:
+        self._tm_hits.inc()
+      return hit, None
+    return None, record.future
+
+  def _load_quietly(self, spec: _TenantSpec, record: _Resident) -> None:
+    """Background-thread wrapper: failures land on the record future
+    (every waiter sees them); nothing to re-raise into."""
+    try:
+      self._load(spec, record)
+    except BaseException:  # noqa: BLE001 — surfaced via the future
+      log.exception("async load of tenant %r failed", spec.tenant)
 
   def _load(self, spec: _TenantSpec, record: _Resident):
     from tensor2robot_tpu.serving.engine import BucketedServingEngine

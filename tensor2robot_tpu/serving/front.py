@@ -10,10 +10,19 @@ with ONE continuous-batching loop over every tenant's queue:
   b ──► [r]        ──► round-robin pick ──► coalesce ≤ max_batch rows
   c ──► [r r]     ╱         │                of ONE tenant
                             ▼
-                  arena.engine(tenant).predict(...)   ◄─ LRU touch,
-                            │                            load on miss
+                  arena.engine_async(tenant)   ◄─ LRU touch; a COLD
+                            │                     tenant's load runs on
+                            ▼                     an arena thread while
+                  engine.predict(...)             the loop serves others
+                            │
                             ▼
                   per-request slices → futures, latency stamped
+
+A cold/evicted tenant never parks the dispatcher (ISSUE 14 satellite):
+its load runs on an arena background thread, the round-robin skips the
+tenant until the load's done-callback wakes the loop, and its queued
+requests then dispatch against the warm engine (or fail with the
+loader's error — the next submit retries the load).
 
 Requests of DIFFERENT tenants never co-batch (different programs);
 continuous batching means the dispatcher never waits between tenants —
@@ -74,14 +83,20 @@ class _Request:
 class _Tenant:
   """Per-tenant front state: bounded queue + carry + metric handles."""
 
-  __slots__ = ("tenant", "queue", "carry", "rng", "tm_request_ms",
-               "tm_completions", "tm_slo_ok", "tm_queue_depth")
+  __slots__ = ("tenant", "queue", "carry", "loading", "rng",
+               "tm_request_ms", "tm_completions", "tm_slo_ok",
+               "tm_queue_depth")
 
   def __init__(self, tenant: str, max_queue: int, seed: int,
                takes_rng: bool):
     self.tenant = tenant
     self.queue: "queue.Queue[_Request]" = queue.Queue(maxsize=max_queue)
     self.carry: Optional[_Request] = None
+    # The tenant's arena load in flight (dispatcher-observed): while
+    # set and unresolved, the round-robin SKIPS this tenant — its
+    # requests wait in the queue, every other tenant keeps dispatching
+    # (cold loads never block the loop; ISSUE 14 satellite).
+    self.loading: Optional[Future] = None
     self.rng = jax.random.PRNGKey(seed) if takes_rng else None
     self.tm_request_ms = tmetrics.histogram(
         f"serving.{tenant}.request_ms")
@@ -286,12 +301,17 @@ class ServingFront:
       raise RuntimeError(
           "ServingFront is closed; submit() after close() would "
           "enqueue into a dead dispatcher.")
-    try:
-      self._work.put_nowait(True)  # coalesced wakeup flag
-    except queue.Full:
-      pass  # a wakeup is already pending — the scan will see us
+    self._wake()
     self._admission.count_admitted(tenant, request.n)
     return True
+
+  def _wake(self, _done_future: Any = None) -> None:
+    """Sets the coalesced wakeup flag (submit path AND arena-load
+    done-callbacks — the signature tolerates the Future argument)."""
+    try:
+      self._work.put_nowait(True)
+    except queue.Full:
+      pass  # a wakeup is already pending — the scan will see us
 
   def predict(self, tenant: str, features: Any) -> Any:
     """Blocking predict — submit + wait (a control loop's tick)."""
@@ -299,8 +319,14 @@ class ServingFront:
 
   # ---- dispatcher thread ----
 
+  @staticmethod
+  def _load_in_flight(entry: _Tenant) -> bool:
+    return entry.loading is not None and not entry.loading.done()
+
   def _next_tenant(self) -> Optional[_Tenant]:
-    """Round-robin over tenants with pending work (fair share)."""
+    """Round-robin over tenants with pending work (fair share).
+    Tenants whose arena load is still in flight are skipped — their
+    turn comes when the load's done-callback wakes the dispatcher."""
     with self._submit_lock:
       order = list(self._order)
       start = self._rr
@@ -308,7 +334,7 @@ class ServingFront:
     for offset in range(count):
       tenant_id = order[(start + offset) % count]
       entry = self._tenants[tenant_id]
-      if entry.pending():
+      if entry.pending() and not self._load_in_flight(entry):
         with self._submit_lock:
           self._rr = (start + offset + 1) % count
         return entry
@@ -323,6 +349,14 @@ class ServingFront:
         # Drained: every queue and carry is empty.
         if all(not t.pending() for t in self._tenants.values()):
           return
+        # Pending work behind an in-flight load: park on the wakeup
+        # flag (the load's done-callback sets it) instead of spinning
+        # the drain scan hot.
+        if any(self._load_in_flight(t) for t in self._tenants.values()):
+          try:
+            self._work.get(timeout=0.05)
+          except queue.Empty:
+            pass
         continue
       try:
         # Idle: park on the wakeup flag. A stale flag costs one empty
@@ -335,15 +369,37 @@ class ServingFront:
     entry = self._next_tenant()
     if entry is None:
       return False
+    # A load that just resolved: surface its outcome before dispatch.
+    load, entry.loading = entry.loading, None
+    if load is not None and load.exception() is not None:
+      # The load failed — its queued requests get the loader's error
+      # (claim-first, so a cancelled future can't poison delivery);
+      # the NEXT submit triggers a fresh load attempt.
+      max_batch = self._arena.spec(entry.tenant).max_batch
+      batch, entry.carry = coalesce.take_batch(
+          entry.queue, entry.carry, max_batch, 0.0)
+      failed = coalesce.claim_batch(batch)
+      if failed:
+        coalesce.fail_batch(failed, load.exception())
+      return bool(batch)
+    # Async arena touch (LRU bump; load-on-miss runs on an arena
+    # thread): a cold tenant never parks this dispatcher — mark it
+    # loading, wake on completion, serve everyone else meanwhile.
+    engine, pending = self._arena.engine_async(entry.tenant)
+    if pending is not None:
+      entry.loading = pending
+      pending.add_done_callback(self._wake)
+      return True  # turn consumed; the tenant waits on its load
     max_batch = self._arena.spec(entry.tenant).max_batch
     batch, entry.carry = coalesce.take_batch(
         entry.queue, entry.carry, max_batch, self._max_wait)
     if not batch:
       return False
-    self._dispatch(entry, batch)
+    self._dispatch(entry, batch, engine)
     return True  # queue entries were consumed either way
 
-  def _dispatch(self, entry: _Tenant, batch: List[_Request]) -> None:
+  def _dispatch(self, entry: _Tenant, batch: List[_Request],
+                engine: Any) -> None:
     # Claim first (shared coalesce contract): requests cancelled while
     # queued drop out here, survivors can't be cancelled — delivery
     # can never hit a poisoned future.
@@ -354,10 +410,6 @@ class ServingFront:
       rows = sum(r.n for r in batch)
       entry.tm_queue_depth.set(entry.queue.qsize())
       features = coalesce.concat_features(batch)
-      # The arena touch: LRU bump, load-on-miss (an evicted tenant
-      # pays its warm reload HERE, on the dispatcher thread — the
-      # latency cliff the compile cache flattens to deserialization).
-      engine = self._arena.engine(entry.tenant)
       with telemetry.span("serving.front_dispatch",
                           tenant=entry.tenant,
                           requests=len(batch), rows=rows):

@@ -81,6 +81,30 @@ def make_front(tmp_path, admission=None, **front_kwargs):
   return ServingFront(arena, admission, **front_kwargs)
 
 
+def park_dispatcher(front, tenant="slow"):
+  """Parks the front's dispatcher inside `tenant`'s predict (a slow
+  device program) until the returned event is set — the deterministic
+  queue-buildup rig for the bound tests. Loads no longer park the
+  dispatcher (they run on arena threads, ISSUE 14), so the park point
+  is the dispatch itself: the tenant must already be registered
+  `preload=True`. Returns (release_event, the parked request's
+  future)."""
+  engine = front.arena.engine(tenant)
+  release = threading.Event()
+  entered = threading.Event()
+  orig_predict = engine.predict
+
+  def blocking_predict(*args, **kwargs):
+    entered.set()
+    release.wait(timeout=30.0)
+    return orig_predict(*args, **kwargs)
+
+  engine.predict = blocking_predict
+  parked = front.submit(tenant, ones(1))
+  assert entered.wait(timeout=10.0)  # dispatcher is now parked
+  return release, parked
+
+
 class TestArena:
 
   def test_lru_eviction_at_budget(self, tmp_path):
@@ -185,6 +209,29 @@ class TestArena:
     arena.engine("a")
     assert calls == [4.0, 4.0]  # loader ran once per load
 
+  def test_async_cold_load_counts_one_miss_no_pickup_hit(self, tmp_path):
+    """A cold engine_async load is ONE logical dispatch: the miss at
+    load start, then the dispatcher's post-load re-touch, must not
+    also count a warm hit (the sync engine() path counts that same
+    dispatch once) — genuine warm touches afterwards still do."""
+    arena = ModelArena(cache_dir=str(tmp_path / "cache"))
+    arena.register("a", make_loader(2.0), max_batch=1)
+    before = tmetrics.registry().snapshot()["counters"]
+    engine, future = arena.engine_async("a")
+    assert engine is None
+    future.result(timeout=30.0)
+    engine, future = arena.engine_async("a")  # the pickup re-touch
+    assert engine is not None and future is None
+    mid = tmetrics.registry().snapshot()["counters"]
+    assert (mid.get("serving.arena.misses", 0.0)
+            - before.get("serving.arena.misses", 0.0)) == 1.0
+    assert (mid.get("serving.arena.hits", 0.0)
+            - before.get("serving.arena.hits", 0.0)) == 0.0
+    arena.engine_async("a")  # a real warm hit counts
+    after = tmetrics.registry().snapshot()["counters"]
+    assert (after.get("serving.arena.hits", 0.0)
+            - before.get("serving.arena.hits", 0.0)) == 1.0
+
 
 class TestAdmission:
 
@@ -232,23 +279,16 @@ class TestAdmission:
 
   def _front_with_stuck_dispatcher(self, tmp_path, policy):
     """A front whose dispatcher is parked inside a slow tenant's
-    loader — deterministic queue buildup for the bound tests."""
-    release = threading.Event()
-    loaded = threading.Event()
-    base_loader = make_loader(3.0)
-
-    def slow_loader():
-      loaded.set()
-      release.wait(timeout=30.0)
-      return base_loader()
-
+    DISPATCH — deterministic queue buildup for the bound tests (a
+    slow LOAD no longer parks the dispatcher: ISSUE 14 async arena
+    loads, pinned in TestFront)."""
     front = make_front(tmp_path)
-    front.register_tenant("slow", slow_loader,
-                          policy=TenantPolicy(slo_ms=1000.0))
+    front.register_tenant("slow", make_loader(3.0),
+                          policy=TenantPolicy(slo_ms=1000.0),
+                          preload=True)
     front.register_tenant("x", make_loader(1.0), policy=policy,
                           preload=True)
-    slow_future = front.submit("slow", ones(1))
-    assert loaded.wait(timeout=10.0)  # dispatcher is now stuck
+    release, slow_future = park_dispatcher(front)
     return front, release, slow_future
 
   def test_bounded_queue_drop_counts_and_rejects(self, tmp_path):
@@ -480,18 +520,69 @@ class TestFront:
       # request — sustained load must not grow it.
       assert front._work.qsize() <= 1
 
+  def test_cold_tenant_load_never_blocks_other_tenants(self, tmp_path):
+    """ISSUE 14 satellite pin: a cold tenant's load runs OFF the
+    dispatcher thread — tenant B keeps completing requests end to end
+    while the load is in flight, and the cold tenant's request is
+    served once its load lands."""
+    gate = threading.Event()
+    entered = threading.Event()
+    base_loader = make_loader(3.0)
+
+    def cold_loader():
+      entered.set()
+      gate.wait(timeout=30.0)
+      return base_loader()
+
+    front = make_front(tmp_path)
+    front.register_tenant("cold", cold_loader,
+                          policy=TenantPolicy(slo_ms=1000.0))
+    front.register_tenant("b", make_loader(1.0), preload=True)
+    try:
+      cold_future = front.submit("cold", ones(1))
+      assert entered.wait(timeout=10.0)  # load started (arena thread)
+      # Full round trips through the SAME dispatcher the load would
+      # previously have parked: every one must complete while the
+      # cold load is still gated open.
+      for _ in range(10):
+        np.testing.assert_allclose(
+            front.predict("b", ones(1))["y"], 1.0)
+      assert not cold_future.done()  # the load outlived all 10
+    finally:
+      gate.set()
+    np.testing.assert_allclose(cold_future.result(timeout=30)["y"], 3.0)
+    front.close()
+
+  def test_failed_load_fails_queued_requests_and_submit_retries(
+      self, tmp_path):
+    """A loader failure surfaces on the queued requests' futures (the
+    dispatcher never dies), and the tenant's NEXT submit triggers a
+    fresh load attempt."""
+    calls = []
+
+    def flaky_loader():
+      calls.append(1)
+      if len(calls) == 1:
+        raise RuntimeError("flaky loader boom")
+      return make_loader(2.0)()
+
+    front = make_front(tmp_path)
+    front.register_tenant("f", flaky_loader,
+                          policy=TenantPolicy(slo_ms=1000.0))
+    doomed = front.submit("f", ones(1))
+    with pytest.raises(RuntimeError, match="flaky loader boom"):
+      doomed.result(timeout=30)
+    out = front.predict("f", ones(1))  # retried load, now warm
+    np.testing.assert_allclose(out["y"], 2.0)
+    front.close()
+
   def test_round_robin_fair_share(self, tmp_path):
     """A deep queue (6 waiting requests) must not starve a shallow one
     (2): round-robin serves B's first dispatch before A's last."""
-    release = threading.Event()
-
-    def slow_loader():
-      release.wait(timeout=30.0)
-      return make_loader(1.0)()
-
     front = make_front(tmp_path)
-    front.register_tenant("slow", slow_loader,
-                          policy=TenantPolicy(slo_ms=1000.0))
+    front.register_tenant("slow", make_loader(1.0),
+                          policy=TenantPolicy(slo_ms=1000.0),
+                          preload=True)
     front.register_tenant("a", make_loader(1.0), max_batch=2,
                           preload=True)
     front.register_tenant("b", make_loader(2.0), max_batch=2,
@@ -503,9 +594,8 @@ class TestFront:
         order.append(tenant)
       return _done
 
+    release, stuck = park_dispatcher(front)
     try:
-      stuck = front.submit("slow", ones(1))
-      time.sleep(0.1)  # dispatcher parks inside slow's loader
       futures = []
       for _ in range(6):
         future = front.submit("a", ones(1))
@@ -530,20 +620,14 @@ class TestFront:
     """A caller cancelling its queued future must not cost the
     requests coalesced around it their results (the claim-then-deliver
     contract in serving/coalesce.py)."""
-    release = threading.Event()
-
-    def slow_loader():
-      release.wait(timeout=30.0)
-      return make_loader(1.0)()
-
     front = make_front(tmp_path)
-    front.register_tenant("slow", slow_loader,
-                          policy=TenantPolicy(slo_ms=1000.0))
+    front.register_tenant("slow", make_loader(1.0),
+                          policy=TenantPolicy(slo_ms=1000.0),
+                          preload=True)
     front.register_tenant("x", make_loader(5.0), max_batch=4,
                           preload=True)
+    release, stuck = park_dispatcher(front)
     try:
-      stuck = front.submit("slow", ones(1))
-      time.sleep(0.1)  # dispatcher parks inside slow's loader
       before = front.submit("x", ones(1))
       doomed = front.submit("x", ones(1))
       after = front.submit("x", ones(1))
