@@ -173,120 +173,15 @@ SCAN_STEPS = 200
 TRIALS = 6
 
 
-def _same_conv_taps(h: int, k: int, s: int):
-  """(out_size, valid_taps) of one spatial dim of a SAME conv.
-
-  XLA cost analysis counts only VALID multiply-adds — border output
-  positions whose window overlaps SAME padding contribute fewer taps
-  (probed: a lone 8×8 stride-2 3×3 conv costs 11²/12² of the naive
-  k² count). Mirroring that here keeps analytic/XLA ratios ≈ 1.
-  """
-  pad_total = max(k - (s if h % s == 0 else h % s), 0)
-  pad_low = pad_total // 2
-  out = -(-h // s)
-  taps = sum(min(i * s - pad_low + k, h) - max(i * s - pad_low, 0)
-             for i in range(out))
-  return out, taps
-
-
-def analytic_flops(kind: str, **kw):
-  """THE shared analytic-FLOPs model for every MFU figure in this file.
-
-  MFU's denominator is MODEL flops from shapes — NOT XLA's count of
-  the compiled program — so the figure stays comparable across
-  dtype/remat/kernel levers: an int8 tower or a remat recompute does
-  not change the model, only the schedule, and must not move the
-  denominator (docs/PERF.md). XLA cost analysis rides along in the
-  detail sections as a cross-check (`xla_flops_per_step`, ratio
-  asserted near 1 on the unlevered program).
-
-  kinds:
-    "qtopt_step": one fused Bellman step — kw: learner, batch_size.
-      CEM target (encode once + I scored populations through the
-      linearity-split head) + critic fwd/bwd (bwd = 2× fwd) + the
-      elementwise optimizer/Polyak tail.
-    "attention": flash attention forward — kw: b, heads, d, t,
-      causal. (The long-context axis's 4·B·H·D·T² [/2 causal].)
-  """
-  if kind == "attention":
-    flops = 4 * kw["b"] * kw["heads"] * kw["d"] * kw["t"] * kw["t"]
-    return flops / 2 if kw.get("causal", True) else flops
-
-  if kind != "qtopt_step":
-    raise ValueError(f"unknown analytic_flops kind {kind!r}")
-  learner = kw["learner"]
-  batch = kw["batch_size"]
-  model = learner.model
-  net = model.network
-  s2d = net.space_to_depth
-  h = model.image_size // max(s2d, 1)
-  cin = 3 * max(s2d, 1) ** 2
-
-  def conv_flops(n, h_in, k, s, ci, co):
-    out, taps = _same_conv_taps(h_in, k, s)
-    return out, 2 * n * taps * taps * ci * co
-
-  def seq_convs(n, h_in, ci, filters, first_stride):
-    """Conv stack flops + BN/relu elementwise; returns (flops, h, c)."""
-    total = 0.0
-    for i, co in enumerate(filters):
-      s = first_stride if i == 0 else 2
-      h_in, f = conv_flops(n, h_in, 3, s, ci, co)
-      total += f + 3 * n * h_in * h_in * co  # BN affine + relu
-      ci = co
-    return total, h_in, ci
-
-  torso_first_stride = 1 if s2d > 1 else 2
-  encode_n1, he, ce = seq_convs(1, h, cin, net.torso_filters,
-                                torso_first_stride)
-
-  from tensor2robot_tpu.data.abstract_input_generator import Mode
-  extras_dim = sum(
-      int(np.prod(spec.shape))
-      for key, spec in model.get_feature_specification(
-          Mode.TRAIN).to_flat_dict().items()
-      if key not in ("image", "action"))
-  emb_in = model.action_dim + extras_dim
-  emb = net.action_embedding_size
-  merge_c = net.torso_filters[-1] if net.torso_filters else 3
-  embed_row = 2 * (emb_in * emb + emb * merge_c)
-
-  qhead_dims = [net.head_filters[-1] if net.head_filters else merge_c]
-  qhead_dims += list(net.dense_sizes) + [1]
-  qhead_row = 2 * sum(a * b for a, b in zip(qhead_dims[:-1],
-                                            qhead_dims[1:]))
-
-  p = learner.cem_population
-  iters = learner.cem_iterations
-  rows = batch * p
-  per_iter = rows * (embed_row + qhead_row)
-  if net.head_filters:
-    h2, conv0_row = conv_flops(1, he, 3, 2, ce, net.head_filters[0])
-    c1 = net.head_filters[0]
-    # The linearity split: per-sample action contribution is a GEMM
-    # against the [C, h2·w2·C'] tap-sum tensor, then merge + tail.
-    per_iter += rows * 2 * ce * h2 * h2 * c1        # act GEMM
-    per_iter += rows * 2 * h2 * h2 * c1             # merge add + relu
-    tail, ht, ct = seq_convs(rows, h2, c1, net.head_filters[1:], 2)
-    per_iter += tail + rows * ht * ht * ct          # + mean pool
-    base = (batch * encode_n1
-            + batch * conv0_row                      # enc0, CSE'd
-            + ce * conv0_row)                        # basis tap-sums
-  else:
-    per_iter += rows * he * he * ce                  # pool fallback
-    base = batch * encode_n1
-  cem = base + iters * per_iter
-
-  # Critic fwd: full encode + head at batch rows; bwd = 2× fwd.
-  head_f, hh, hc = ((seq_convs(1, he, ce, net.head_filters, 2))
-                    if net.head_filters else (0.0, he, ce))
-  critic_fwd = batch * (encode_n1 + head_f + hh * hh * hc
-                        + embed_row + qhead_row)
-  # Optimizer/Polyak/grad-norm elementwise tail over the param count.
-  n_params = sum(
-      int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(
-          kw["params"])) if "params" in kw else 0
-  return cem + 3 * critic_fwd + 14 * n_params
+# THE shared analytic-FLOPs MFU denominator — hoisted to
+# `utils/profiling.py` (ISSUE 15) so the trainers' live `perf.mfu`
+# gauges and this file's bench MFU are one code path by construction;
+# re-exported here so `bench.analytic_flops` keeps working (the
+# deprecation re-export — new callers import from utils.profiling).
+from tensor2robot_tpu.utils.profiling import (  # noqa: E402
+    _same_conv_taps,
+    analytic_flops,
+)
 
 
 def build(paper, width: int = 64, cem_inference: str = "int8",
@@ -2172,6 +2067,11 @@ def _telemetry_overhead_probe(dry_run: bool = False):
     model_dir = tempfile.mkdtemp(prefix="t2r_tel_overhead_")
     trace_dir = os.path.join(model_dir, "telemetry")
     try:
+      # The ON arm now carries the WHOLE always-on plane (ISSUE 15):
+      # tracing + live perf gauges + the resource sampler thread + the
+      # sentinel; the OFF arm disables all of it — the <2% gate
+      # re-verified with the sampler and sentinel running.
+      telemetry.perf.set_plane_enabled(tracing)
       if tracing:
         telemetry.configure("trainer", trace_dir=trace_dir)
       else:
@@ -2189,12 +2089,16 @@ def _telemetry_overhead_probe(dry_run: bool = False):
           os.path.join(model_dir, "metrics_train.jsonl"))
       return float(records[-1]["grad_steps_per_sec"])
     finally:
+      # The sampler is a process-global singleton: stop it so the next
+      # (possibly OFF) arm runs without a leftover thread.
+      telemetry.perf.stop_resource_sampler()
       shutil.rmtree(model_dir, ignore_errors=True)
 
   rates = {True: [], False: []}
   for _ in range(trials):
     for tracing in (False, True):  # alternate: noise hits both arms
       rates[tracing].append(run_once(tracing))
+  telemetry.perf.set_plane_enabled(None)  # back to the env default
   telemetry.core.reset_for_tests()  # leave the process unconfigured
   on, off = max(rates[True]), max(rates[False])
   return {
@@ -2226,6 +2130,7 @@ def bench_telemetry(dry_run: bool = False):
       the throwaway model_dir (tier-1 must not touch committed
       artifacts).
   """
+  import dataclasses
   import shutil
   import tempfile
 
@@ -2289,6 +2194,26 @@ def bench_telemetry(dry_run: bool = False):
         raise SystemExit(
             f"fleet_metrics.jsonl record failed the envelope "
             f"schema: {problems}")
+    # SENTINEL quiet gate (ISSUE 15): an uninjected run must fire ZERO
+    # alerts — learner-side (train_qtopt's sentinel) and fleet-side
+    # (the orchestrator's) both append to this file.
+    from tensor2robot_tpu.telemetry import sentinel as sentinel_lib
+    quiet_alerts = sentinel_lib.read_alerts(
+        os.path.join(trace_dir, sentinel_lib.ALERTS_FILENAME))
+    if quiet_alerts:
+      raise SystemExit(
+          f"sentinel quiet gate: uninjected fleet fired "
+          f"{len(quiet_alerts)} alert(s): "
+          f"{[a.get('rule') for a in quiet_alerts]}")
+    # The aggregated view must carry the resource watermarks every
+    # role's sampler publishes (rsrc.* rides telemetry_push for free).
+    rsrc_keys = sorted({
+        k for record in aggregated for k in record.get("payload", {})
+        if "rsrc." in k})
+    if not rsrc_keys:
+      raise SystemExit(
+          "fleet_metrics.jsonl carries no rsrc.* watermarks — the "
+          "resource sampler plane is dark")
     if not dry_run:
       out_path = os.path.join(
           os.path.dirname(os.path.abspath(__file__)), "artifacts",
@@ -2298,13 +2223,70 @@ def bench_telemetry(dry_run: bool = False):
   finally:
     shutil.rmtree(model_dir, ignore_errors=True)
 
+  # SENTINEL injected-stall gate: a second tiny fleet with ONE
+  # slow_host stall (3s against a 1s RPC deadline) injected through
+  # the real fault seams. The stalled client times out, retries, and
+  # recovers; the orchestrator's page-severity rpc_timeouts watch must
+  # fire EXACTLY ONE alert train, with flight records attached (the
+  # orchestrator's own view + the host's ring — the hang path's
+  # artifacts, produced by a regression instead of a crash).
+  from tensor2robot_tpu import config as gin_config
+  from tensor2robot_tpu.fleet import faults as faults_lib
+  from tensor2robot_tpu.telemetry import flightrec as flightrec_lib
+  stall_plan = faults_lib.FaultPlan(seed=7, events=(
+      faults_lib.FaultEvent(
+          fault=faults_lib.SLOW_HOST, target="host", at=5,
+          duration_secs=3.0, method="sample"),))
+  stall_config = dataclasses.replace(
+      config, max_train_steps=24, rpc_call_timeout_secs=1.0,
+      rpc_max_retries=2, telemetry_poll_secs=1.0,
+      fault_plan=stall_plan)
+  gin_config.bind_parameter(
+      "fleet_watches.rpc_timeout_severity", "page")
+  stall_dir = tempfile.mkdtemp(prefix="t2r_telemetry_sentinel_")
+  try:
+    Fleet(stall_config, stall_dir).run()
+    stall_alerts = sentinel_lib.read_alerts(os.path.join(
+        stall_dir, "telemetry", sentinel_lib.ALERTS_FILENAME))
+    timeout_alerts = [a for a in stall_alerts
+                      if a.get("rule") == "rpc_timeouts"]
+    if len(timeout_alerts) != 1:
+      raise SystemExit(
+          f"sentinel stall gate: expected exactly 1 rpc_timeouts "
+          f"alert, got {len(timeout_alerts)} "
+          f"(all alerts: {[a.get('rule') for a in stall_alerts]})")
+    dumps = flightrec_lib.read_dumps(
+        flightrec_lib.flightrec_dir(stall_dir))
+    page_dumps = [d for d in dumps
+                  if "sentinel page" in str(d.get("reason", ""))]
+    if not page_dumps:
+      raise SystemExit(
+          "sentinel stall gate: page alert fired but no flight "
+          f"record carries it (dumps: "
+          f"{[d.get('reason') for d in dumps]})")
+    sentinel_section = {
+        "injected_fault": "slow_host (3s stall vs 1s rpc deadline)",
+        "alerts": [{k: a.get(k) for k in
+                    ("rule", "metric", "role", "severity")}
+                   for a in stall_alerts],
+        "page_flight_records": sorted(
+            str(d.get("role")) for d in page_dumps),
+        "quiet_run_alerts": 0,
+    }
+  finally:
+    gin_config.clear_config()
+    shutil.rmtree(stall_dir, ignore_errors=True)
+
   section = {
       "device_kind": jax.devices()[0].device_kind,
       "host_cores": os.cpu_count(),
       **overhead,
       "merged_roles": sorted(roles),
       "merged_spans": trace["metadata"]["span_count"],
+      "rpc_flows": trace["metadata"].get("rpc_flows", 0),
       "aggregated_metric_records": len(aggregated),
+      "rsrc_watermark_keys": rsrc_keys[:8],
+      "sentinel": sentinel_section,
       "fleet_env_steps_per_sec": round(result.env_steps_per_sec, 1),
       "artifact": (None if dry_run
                    else "artifacts/telemetry/fleet_trace.json.gz"),
@@ -3326,8 +3308,13 @@ def main():
             smoke["steps_per_sec_tracing_off"],
         "merged_roles": smoke["merged_roles"],
         "merged_spans": smoke["merged_spans"],
+        "rpc_flows": smoke["rpc_flows"],
         "aggregated_metric_records":
             smoke["aggregated_metric_records"],
+        "rsrc_watermark_keys": smoke["rsrc_watermark_keys"],
+        "sentinel_alerts": smoke["sentinel"]["alerts"],
+        "sentinel_page_flight_records":
+            smoke["sentinel"]["page_flight_records"],
     }))
     return
   if "--serving" in args and "--dry-run" in args:

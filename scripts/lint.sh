@@ -24,7 +24,7 @@ import sys
 
 from tensor2robot_tpu.analysis.cli import main
 
-rc = main(["--checks", "jax,concurrency,imports"])
+rc = main(["--checks", "jax,concurrency,imports,obs"])
 if "jax" in sys.modules:
     print("lint.sh: the AST lint path imported jax — the fast-path "
           "invariant broke (see analysis/__init__.py)", file=sys.stderr)

@@ -62,10 +62,22 @@ envs_rc=$?
 # 2-actor fleet runs with the telemetry plane on, every process's
 # trace merges into one timeline, and the smoke FAILS unless spans
 # from the learner, the host, and both actors are present; the
-# tracing-overhead A/B probe rides along.
-echo "--- telemetry smoke (bench.py --telemetry --dry-run; trace merge) ---"
+# tracing-overhead A/B probe rides along (now with the ISSUE-15
+# sampler + sentinel on in the ON arm). The sentinel legs ride too:
+# the quiet fleet must fire ZERO alerts and a second fleet with an
+# injected slow_host stall must fire exactly one page alert train
+# with flight records attached.
+echo "--- telemetry smoke (bench.py --telemetry --dry-run; trace merge + sentinel) ---"
 env JAX_PLATFORMS=cpu python bench.py --telemetry --dry-run
 telemetry_rc=$?
+
+# The run-report tool (ISSUE 15) must stay able to fold a run dir —
+# the committed artifacts/telemetry/ merged trace is the fixture; a
+# report with zero renderable sections exits nonzero.
+echo "--- telemetry report smoke (python -m tensor2robot_tpu.telemetry.report) ---"
+env JAX_PLATFORMS=cpu python -m tensor2robot_tpu.telemetry.report \
+  --run-dir artifacts/telemetry --out /tmp/_t1_report.md > /dev/null
+report_rc=$?
 
 # The chaos smoke is the ISSUE-14 recovery gate: a REAL (tiny)
 # 2-actor fleet runs the full seeded 7-class fault schedule through
@@ -87,4 +99,5 @@ if [ "$mfu_rc" -ne 0 ]; then exit "$mfu_rc"; fi
 if [ "$fleet_rc" -ne 0 ]; then exit "$fleet_rc"; fi
 if [ "$envs_rc" -ne 0 ]; then exit "$envs_rc"; fi
 if [ "$telemetry_rc" -ne 0 ]; then exit "$telemetry_rc"; fi
+if [ "$report_rc" -ne 0 ]; then exit "$report_rc"; fi
 exit "$chaos_rc"

@@ -51,6 +51,10 @@ _CONCURRENCY_PATHS = (
     "tensor2robot_tpu/telemetry",
 )
 _GIN_PATHS = ("tensor2robot_tpu",)
+# obs (OBS501, ISSUE 15) scans the package's literal metric names
+# against the docs/OBSERVABILITY.md catalog; tests/bench construct
+# fixture names on purpose and are out of scope.
+_OBS_PATHS = ("tensor2robot_tpu",)
 
 
 def _resolve_paths(paths: Sequence[str], root: str) -> List[str]:
@@ -79,6 +83,10 @@ def run_checks(checks: Sequence[str], root: str,
           run_import_rules,
       )
       findings.extend(run_import_rules(root))
+    elif family == "obs":
+      from tensor2robot_tpu.analysis.obs_rules import run_obs_rules
+      findings.extend(run_obs_rules(
+          _resolve_paths(paths or _OBS_PATHS, root), root))
     elif family == "gin":
       from tensor2robot_tpu.analysis.gin_check import run_gin_rules
       findings.extend(run_gin_rules(
@@ -104,7 +112,7 @@ def build_parser() -> argparse.ArgumentParser:
                   "(gin validator, JAX tracing-hazard linter, "
                   "concurrency/lifecycle linter).")
   parser.add_argument(
-      "--checks", default="jax,concurrency,imports,gin",
+      "--checks", default="jax,concurrency,imports,obs,gin",
       help="comma-separated families to run "
            f"({','.join(FAMILIES)}); note `gin` imports the "
            "framework, the rest are pure-AST")

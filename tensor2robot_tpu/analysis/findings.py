@@ -66,9 +66,12 @@ RULE_CATALOG: Dict[str, Tuple[str, str]] = {
     # import hygiene (family "imports")
     "IMP401": ("imports", "Plane-worker-safe module (transitively) "
                           "imports jax/tensorflow at module level"),
+    # observability hygiene (family "obs")
+    "OBS501": ("obs", "Literal telemetry metric name missing from "
+                      "docs/OBSERVABILITY.md's catalog"),
 }
 
-FAMILIES = ("gin", "jax", "concurrency", "imports")
+FAMILIES = ("gin", "jax", "concurrency", "imports", "obs")
 
 
 def rules_for_family(family: str) -> List[str]:

@@ -1,0 +1,164 @@
+"""Observability hygiene: the metric catalog cannot drift (OBS501).
+
+docs/OBSERVABILITY.md's metric catalog is the operator's map of every
+name the telemetry registry can emit — dashboards, the sentinel's
+watch rules, and the Prometheus scrape all key on it. Before this rule
+the catalog was prose: a new ``telemetry.counter("replay.foo")`` call
+site silently shipped an undocumented metric. OBS501 pins the
+contract statically: every **string-literal** name passed to a
+``counter`` / ``gauge`` / ``histogram`` call in the package must match
+an entry of the catalog.
+
+Catalog parsing is deliberately permissive: every backtick-quoted
+token in the doc that looks like a metric name becomes a pattern,
+with two expansions —
+
+  * ``{a,b,c}`` brace alternation
+    (``fleet.rpc.{timeouts,retries,reconnects,recovered}``);
+  * ``<placeholder>`` wildcards (``serving.<tenant>.request_ms``,
+    ``rsrc.device<i>_mem_bytes``) matching any name fragment.
+
+Dynamically-built names (f-strings — per-tenant, per-rule, per-fault
+families) are out of static reach; their FAMILY rows use the same
+placeholder syntax and are covered by convention, not by this rule.
+
+Pure AST + one markdown read: no jax import (lint.sh stage 1).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import List, Optional, Sequence
+
+from tensor2robot_tpu.analysis.findings import Finding, rel_path
+
+METRIC_CALLS = ("counter", "gauge", "histogram")
+CATALOG_PATH = os.path.join("docs", "OBSERVABILITY.md")
+
+# A backticked doc token that can be a metric name (or a brace/
+# placeholder family of them).
+_TOKEN_RE = re.compile(r"`([a-z0-9_.{}<>,\-]+)`")
+# A code literal we hold to the catalog: dotted lowercase metric names
+# (every registry name in this repo is namespaced with at least one
+# dot; undotted literals are not metric names).
+_METRIC_NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_.\-]+)+$")
+
+
+def _expand_braces(token: str) -> List[str]:
+  match = re.search(r"\{([^{}]*)\}", token)
+  if not match:
+    return [token]
+  head, tail = token[:match.start()], token[match.end():]
+  out: List[str] = []
+  for part in match.group(1).split(","):
+    out.extend(_expand_braces(head + part.strip() + tail))
+  return out
+
+
+def catalog_patterns(markdown: str) -> List[re.Pattern]:
+  """Compiled full-match patterns for every catalog-shaped token."""
+  patterns: List[re.Pattern] = []
+  seen = set()
+  for raw in _TOKEN_RE.findall(markdown):
+    for token in _expand_braces(raw):
+      if token in seen:
+        continue
+      seen.add(token)
+      # A token must carry literal content OUTSIDE its placeholders:
+      # a bare `<rest>` in prose would otherwise compile to a
+      # match-everything wildcard and blind the whole rule.
+      if not re.search(r"[a-z0-9]", re.sub(r"<[^<>]*>", "", token)):
+        continue
+      # `<placeholder>` → wildcard fragment; everything else literal.
+      regex = "".join(
+          "[a-zA-Z0-9_.\\-]+" if piece.startswith("<") else
+          re.escape(piece)
+          for piece in re.split(r"(<[^<>]*>)", token) if piece)
+      patterns.append(re.compile(regex + r"\Z"))
+  return patterns
+
+
+def _literal_metric_calls(tree: ast.AST):
+  """(lineno, name) for every counter/gauge/histogram call whose first
+  argument is a string literal."""
+  for node in ast.walk(tree):
+    if (isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr in METRIC_CALLS
+        and node.args
+        and isinstance(node.args[0], ast.Constant)
+        and isinstance(node.args[0].value, str)):
+      yield node.args[0].lineno, node.args[0].value
+
+
+def _scope_of(tree: ast.AST, lineno: int) -> str:
+  """Innermost enclosing def/class qualname of a line (best-effort)."""
+  best: List[str] = []
+
+  def visit(node: ast.AST, stack: List[str]) -> None:
+    for child in ast.iter_child_nodes(node):
+      if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)):
+        end = getattr(child, "end_lineno", None)
+        inner = stack + [child.name]
+        if child.lineno <= lineno and (end is None or lineno <= end):
+          best.clear()
+          best.extend(inner)
+        visit(child, inner)
+      else:
+        visit(child, stack)
+
+  visit(tree, [])
+  return ".".join(best)
+
+
+def _python_files(paths: Sequence[str]) -> List[str]:
+  files: List[str] = []
+  for path in paths:
+    if os.path.isfile(path):
+      files.append(path)
+      continue
+    for dirpath, _, names in os.walk(path):
+      files.extend(os.path.join(dirpath, name)
+                   for name in names if name.endswith(".py"))
+  return sorted(files)
+
+
+def run_obs_rules(paths: Sequence[str], root: str,
+                  catalog_path: Optional[str] = None
+                  ) -> List[Finding]:
+  """OBS501 over `paths` against the catalog markdown (default:
+  <root>/docs/OBSERVABILITY.md). A missing catalog is itself a
+  finding — the contract cannot be silently absent."""
+  catalog = catalog_path or os.path.join(root, CATALOG_PATH)
+  try:
+    with open(catalog, encoding="utf-8") as f:
+      patterns = catalog_patterns(f.read())
+  except OSError:
+    return [Finding(
+        "OBS501", rel_path(catalog, root), 0, "",
+        "metric catalog missing or unreadable — every "
+        "telemetry.{counter,gauge,histogram} literal must be "
+        "documented there")]
+  findings: List[Finding] = []
+  for path in _python_files(paths):
+    try:
+      with open(path, encoding="utf-8") as f:
+        source = f.read()
+      tree = ast.parse(source)
+    except (OSError, SyntaxError):
+      continue
+    for lineno, name in _literal_metric_calls(tree):
+      if not _METRIC_NAME_RE.match(name):
+        continue  # not a namespaced metric name (helper strings)
+      if any(p.match(name) for p in patterns):
+        continue
+      findings.append(Finding(
+          "OBS501", rel_path(path, root), lineno,
+          _scope_of(tree, lineno),
+          f"metric {name!r} is not in the docs/OBSERVABILITY.md "
+          "catalog — document it (placeholder/brace families count) "
+          "or the catalog drifts"))
+  return findings

@@ -49,6 +49,13 @@ flags.DEFINE_bool(
     "jax_init_distributed", False,
     "Force jax.distributed.initialize() even without an explicit "
     "coordinator (TPU pod auto-discovery).")
+flags.DEFINE_integer(
+    "prometheus_port", None,
+    "Start the telemetry/prometheus.py scrape endpoint on this port "
+    "in THIS process before training (0 = ephemeral; the bound port "
+    "is printed). Unset, the gin-backed default applies "
+    "(`default_port.port` in telemetry.prometheus) — so scraping no "
+    "longer requires bench-side wiring (docs/OBSERVABILITY.md).")
 flags.DEFINE_enum(
     "trainer", "train_eval", ["train_eval", "qtopt", "fleet",
                               "anakin"],
@@ -118,6 +125,17 @@ def main(argv):
   )
   _import_configurable_families()
   gin.parse_config_files_and_bindings(configs, FLAGS.gin_bindings)
+  # Prometheus scrape endpoint (ISSUE 15): flag wins, else the
+  # gin-backed default (telemetry.prometheus.default_port). Started
+  # here so EVERY trainer entry — and the fleet orchestrator — serves
+  # /metrics off its live registry with no bench-side wiring.
+  from tensor2robot_tpu.telemetry import prometheus as prometheus_lib
+  prometheus_port = FLAGS.prometheus_port
+  if prometheus_port is None:
+    prometheus_port = prometheus_lib.default_port()
+  if prometheus_port is not None and prometheus_port >= 0:
+    endpoint = prometheus_lib.serve(port=prometheus_port)
+    print(f"prometheus: serving /metrics on port {endpoint.port}")
   if FLAGS.trainer == "qtopt":
     from tensor2robot_tpu.research.qtopt.train_qtopt import train_qtopt
     train_qtopt()

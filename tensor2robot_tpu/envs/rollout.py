@@ -405,6 +405,14 @@ def train_anakin(
   hook_list = HookList(list(hooks))
   from tensor2robot_tpu.startup.compile_cache import CompileWatch
   CompileWatch.install_tap()
+  # The always-on perf plane (ISSUE 15): resource watermarks + alert
+  # sentinel per process, live MFU gauges at log cadence below.
+  from tensor2robot_tpu.telemetry import perf as perf_lib
+  from tensor2robot_tpu.telemetry import sentinel as sentinel_lib
+  from tensor2robot_tpu.utils import profiling
+  perf_lib.start_resource_sampler(
+      sources=[profiling.device_memory_source()])
+  watch_sentinel = sentinel_lib.build_for_run(model_dir)
 
   from tensor2robot_tpu.parallel import mesh as mesh_lib
 
@@ -450,6 +458,19 @@ def train_anakin(
 
   rng = jax.random.PRNGKey(seed)
   state = learner.create_state(rng, batch_size=2)
+  # Live MFU attribution, device-count aware: one optimizer step
+  # consumes `batch_size` rows PER DEVICE (global batch d·B), so the
+  # global-step denominator is the per-device analytic count × d and
+  # the peak scales by d — perf.mfu stays the per-chip
+  # fraction-of-peak of the Bellman model (collection flops ride the
+  # same program but are not model flops; docs/PERF.md).
+  per_device_flops = profiling.qtopt_step_flops(
+      learner, batch_size, params=state.train_state.params)
+  perf_meter = perf_lib.PerfMeter(
+      flops_per_step=(per_device_flops * d
+                      if per_device_flops else None),
+      peak_flops=profiling.device_peak_flops(),
+      devices=d)
   resume_step = ckpt_lib.latest_step(model_dir)
   if resume_step is not None:
     log.info("Resuming anakin QT-Opt from step %d", resume_step)
@@ -768,8 +789,8 @@ def train_anakin(
     while step < max_train_steps:
       # Per-dispatch timing span: one collect-and-learn device program
       # (rollout segment + ring insert + K Bellman steps).
-      with telemetry.span("anakin.dispatch", step=step, k=k,
-                          devices=d):
+      with perf_meter.dispatch("anakin.dispatch", step=step, k=k,
+                               devices=d):
         carry, metrics = anakin_step(
             carry, jax.random.fold_in(iter_key, step))
       step += k
@@ -804,7 +825,19 @@ def train_anakin(
         # one program) — logged so fleet-mode dashboards compare.
         scalars["param_refresh_lag_steps"] = 0.0
         scalars.update(telemetry.registry().scalars("compile_cache."))
+        # Resource watermarks persist with the run (report tool).
+        scalars.update(telemetry.registry().scalars("rsrc."))
+        telemetry.registry().gauge("train.grad_steps_per_sec").set(
+            scalars["grad_steps_per_sec"])
+        # Live utilization (perf.mfu / flops_per_sec /
+        # device_time_fraction) — bench's denominator, pod-aware.
+        scalars.update(perf_meter.publish(
+            scalars["grad_steps_per_sec"], dt))
         metric_logger.write("train", step, scalars)
+        if watch_sentinel is not None:
+          watch_sentinel.evaluate(
+              {**telemetry.registry().scalars(), **scalars},
+              step=step)
         t_last = time.time()
         steps_since_log = 0
       if step % save_checkpoints_steps == 0 or step == max_train_steps:
@@ -828,6 +861,8 @@ def train_anakin(
     except Exception:  # noqa: BLE001 — don't mask the original error
       log.exception("hook end() failed during teardown")
     writer.close()
+    if watch_sentinel is not None:
+      watch_sentinel.close()
     metric_logger.close()
   return device0(carry[0])
 

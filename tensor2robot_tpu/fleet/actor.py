@@ -179,6 +179,10 @@ def actor_main(config, actor_index: int, address, stop_event,
   telemetry.configure(
       actor_id, trace_dir=getattr(config, "telemetry_dir", "") or None,
       actor_id=actor_id)
+  # Resource watermarks (ISSUE 15): host RSS for this jax-free role;
+  # rsrc.* gauges ride the existing telemetry_push to the host.
+  from tensor2robot_tpu.telemetry import perf as perf_lib
+  perf_lib.start_resource_sampler()
   # The fault-plan seam (ISSUE 14): non-recurring events fire only in
   # incarnation 0, so a respawned actor replays a fault-free schedule.
   # `install` also arms the RPC client-side seam for this process.
@@ -275,5 +279,6 @@ def actor_main(config, actor_index: int, address, stop_event,
       flightrec.dump(config.flightrec_dir, f"{actor_id}: {e!r}")
     raise
   finally:
+    perf_lib.stop_resource_sampler()
     telemetry.get_tracer().close()
     client.close()

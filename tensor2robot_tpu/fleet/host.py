@@ -116,6 +116,14 @@ class _HostState:
     # the REFERENCE clock every handshaking client offsets against.
     telemetry.configure(
         "host", trace_dir=getattr(config, "telemetry_dir", "") or None)
+    # Resource watermarks (ISSUE 15): device memory + host RSS +
+    # replay/queue fill peaks as rsrc.* gauges. They live in the
+    # ordinary registry, so the orchestrator's `telemetry` poll
+    # aggregates them fleet-wide for free.
+    from tensor2robot_tpu.telemetry import perf as perf_lib
+    from tensor2robot_tpu.utils import profiling
+    perf_lib.start_resource_sampler(
+        sources=[profiling.device_memory_source()])
     self._learner = _build_learner(config)
     state0 = self._learner.create_state(
         jax.random.PRNGKey(config.seed), batch_size=2)
@@ -417,6 +425,8 @@ def host_main(config, ready_conn, stop_event, heartbeat) -> None:
       proc.beat(heartbeat)
       time.sleep(0.1)
   finally:
+    from tensor2robot_tpu.telemetry import perf as perf_lib
+    perf_lib.stop_resource_sampler()  # no jax calls past teardown
     server.close()
     state.close()
     telemetry.get_tracer().close()  # flush the host's trace tail

@@ -239,6 +239,12 @@ def learner_main(config, model_dir: str, address, heartbeat,
       flightrec.dump(config.flightrec_dir, f"learner: {e!r}")
     raise
   finally:
+    # Stop the perf plane's sampler thread BEFORE the process exits: a
+    # daemon thread mid-call into jax during interpreter teardown
+    # aborts the process (SIGABRT) — the atexit hook in telemetry.perf
+    # is the backstop; this is the explicit path.
+    from tensor2robot_tpu.telemetry import perf as perf_lib
+    perf_lib.stop_resource_sampler()
     telemetry.get_tracer().close()
     stream.close()
     control.close()

@@ -61,7 +61,9 @@ from tensor2robot_tpu.fleet.rpc import RpcClient
 from tensor2robot_tpu.telemetry import core as tcore
 from tensor2robot_tpu.telemetry import flightrec
 from tensor2robot_tpu.telemetry import metrics as tmetrics
+from tensor2robot_tpu.telemetry import perf as perf_lib
 from tensor2robot_tpu.telemetry import records as trecords
+from tensor2robot_tpu.telemetry import sentinel as sentinel_lib
 
 log = logging.getLogger(__name__)
 
@@ -150,6 +152,12 @@ class FleetConfig:
   telemetry_dir: str = ""
   flightrec_dir: str = ""
   telemetry_poll_secs: float = 10.0  # 0 disables the aggregated poll
+  # Alert sentinel over the aggregated fleet view (ISSUE 15): watch
+  # rules (telemetry.sentinel.fleet_watches, gin-tunable) evaluated at
+  # every poll; a page-severity breach dumps flight records naming the
+  # offending role, exactly like the hang path. Needs the telemetry
+  # plane (poll cadence > 0).
+  sentinel: bool = True
   # Fault injection (tests / bench failure-path rehearsal). The
   # legacy single-fault knobs remain; `fault_plan` is the ISSUE-14
   # deterministic schedule (faults.FaultPlan — picklable, shipped to
@@ -271,6 +279,7 @@ class Fleet:
     self._tracer: Optional[tcore.Tracer] = None
     self._telemetry_file: Optional[Any] = None
     self._t_last_poll = 0.0
+    self._sentinel: Optional[sentinel_lib.Sentinel] = None
 
   # ---- launch ----
 
@@ -350,6 +359,17 @@ class Fleet:
       # or a test with its own telemetry identity).
       self._tracer = tcore.Tracer().configure(
           "orchestrator", trace_dir=config.telemetry_dir)
+    if (config.telemetry_dir and config.sentinel
+        and config.telemetry_poll_secs and perf_lib.plane_enabled()):
+      # The fleet sentinel (ISSUE 15): gin-tunable rules evaluated
+      # over every aggregated poll; a page-severity breach triggers
+      # the flight-recorder path below, role-named like the hang path.
+      self._sentinel = sentinel_lib.Sentinel(
+          sentinel_lib.fleet_watches(),
+          alerts_path=os.path.join(config.telemetry_dir,
+                                   sentinel_lib.ALERTS_FILENAME),
+          on_page=self._sentinel_page,
+          tracer=self._tracer)
     parent_conn, child_conn = self._ctx.Pipe()
     self._host = self._ctx.Process(
         target=host_lib.host_main,
@@ -615,6 +635,46 @@ class Fleet:
     if self._tracer is not None:
       self._tracer.event("orchestrator.telemetry_poll",
                          metrics=len(payload))
+    if self._sentinel is not None:
+      # Watch rules over the SAME aggregated view that just landed in
+      # fleet_metrics.jsonl — the sentinel sees exactly what the
+      # operator's dashboard would.
+      self._sentinel.evaluate(payload)
+
+  def _sentinel_page(self, alert: Dict[str, Any]) -> None:
+    """Page-severity alert → the flight-recorder path: the
+    orchestrator dumps its own view (heartbeat ages, restart counts)
+    with the OFFENDING ROLE in the reason — exactly the artifact the
+    hang path produces — and asks a still-live host to dump its ring.
+    Non-fatal: the fleet keeps running; the regression is documented.
+    """
+    if not self._run_config.flightrec_dir:
+      return
+    reason = (f"sentinel page: alert.{alert['rule']} on "
+              f"{alert['metric']} = {alert.get('value'):.6g} "
+              f"(role {alert['role']})")
+    now = time.monotonic()
+    ages = {
+        name: round(now - max(value.value,
+                              self._spawned_at.get(name, 0.0)), 3)
+        for name, value in self._heartbeats.items()}
+    flightrec.dump(
+        self._run_config.flightrec_dir, reason,
+        extra={"alert": alert, "heartbeat_ages_secs": ages,
+               "actor_restarts": dict(self._restarts)},
+        role="orchestrator")
+    if (self._control is not None and self._host is not None
+        and self._host.is_alive()):
+      try:
+        self._control.call("flight_record", {
+            "out_dir": self._run_config.flightrec_dir,
+            "reason": reason}, timeout_secs=15.0)
+      except Exception:  # noqa: BLE001 — forensics must not mask
+        log.warning("host flight-record request failed", exc_info=True)
+        # Poisoned-on-timeout contract (rpc.py): never let a later
+        # control call read this call's late reply.
+        self._control.close()
+        self._control = self._fresh_control()
 
   def _flight_record(self, error: BaseException) -> None:
     """The latched-error / hang-detection flight-recorder trigger:
@@ -871,6 +931,8 @@ class Fleet:
     if self._telemetry_file is not None:
       self._telemetry_file.close()
       self._telemetry_file = None
+    if self._sentinel is not None:
+      self._sentinel.close()
     if self._tracer is not None:
       self._tracer.close()
     leaked = [p.name for p in self._all_processes() if p.is_alive()]

@@ -51,6 +51,7 @@ jax-free actor import).
 from __future__ import annotations
 
 import logging
+import os
 import random
 import threading
 import time
@@ -149,9 +150,16 @@ class RpcServer:
     try:
       while not self._stop.is_set():
         try:
-          method, payload = conn.recv()
+          message = conn.recv()
         except (EOFError, OSError):
           break
+        # Wire format: (method, payload[, req]). `req` is the
+        # client-stamped correlation id (ISSUE 15): echoed into the
+        # server-side span so telemetry.merge links the
+        # rpc_call.<m>/rpc.<m> pair as one Perfetto flow. Two-tuples
+        # stay accepted (id-less callers).
+        method, payload = message[0], message[1]
+        req = message[2] if len(message) > 2 else None
         # Server-side fault seam (chaos): a stall models a slow host,
         # a disconnect models a half-dead one — the break runs the
         # REAL disconnect path below (session abort and all), and the
@@ -167,7 +175,9 @@ class RpcServer:
           # Every RPC method gets a server-side span for free: the
           # merged timeline shows act/commit/sample handler time per
           # connection thread (no-op until telemetry is configured).
-          with telemetry.span(f"rpc.{method}"):
+          # The echoed `req` makes it one flow with the client span.
+          span_args = {"req": req} if req is not None else {}
+          with telemetry.span(f"rpc.{method}", **span_args):
             result = self._handler(method, payload, ctx)
           reply = ("ok", result)
         except BaseException:  # serialized back, connection stays up
@@ -242,6 +252,12 @@ class RpcClient:
     self._max_retries = int(max_retries)
     self.reconnects = 0
     self._conn = None
+    # Correlation-id sequence (ISSUE 15): every call stamps a
+    # process-unique `req` into its client span AND the wire triple;
+    # the server echoes it into its handler span, and telemetry.merge
+    # links the pair as one Perfetto flow event. Single-owner like the
+    # client itself — a bare increment is safe.
+    self._req_seq = 0
     self._connect(connect_timeout_secs)
 
   def _connect(self, timeout_secs: float) -> None:
@@ -274,11 +290,14 @@ class RpcClient:
     """
     timeout = (self._call_timeout if timeout_secs is None
                else timeout_secs)
+    self._req_seq += 1
+    req = f"{os.getpid()}-{id(self) & 0xffffff:x}-{self._req_seq}"
     try:
       # Client-side span: the caller's view of the same RPC (queueing
       # + transport + handler), so actor-vs-host wait decomposes in
-      # the merged timeline.
-      with telemetry.span(f"rpc_call.{method}"):
+      # the merged timeline; `req` links it to the server span as one
+      # flow (telemetry.merge).
+      with telemetry.span(f"rpc_call.{method}", req=req):
         action = _fault_action("client", method)
         if action is not None:
           kind, secs = action
@@ -288,7 +307,7 @@ class RpcClient:
         if action is None:
           # (a "drop" skips the send: the request is lost in flight
           # and the REAL deadline below fires.)
-          self._conn.send((method, payload))
+          self._conn.send((method, payload, req))
         if timeout is not None and not self._conn.poll(timeout):
           tmetrics.counter("fleet.rpc.timeouts").inc()
           raise TimeoutError(

@@ -115,6 +115,16 @@ def train_qtopt(
   # bench --coldstart.
   from tensor2robot_tpu.startup.compile_cache import CompileWatch
   CompileWatch.install_tap()
+  # The always-on perf plane (ISSUE 15): resource watermarks sampled
+  # per process, sentinel rules evaluated at log cadence, and the live
+  # MFU gauges published below (the PerfMeter built once the state
+  # exists — the analytic denominator wants the param count).
+  from tensor2robot_tpu.telemetry import perf as perf_lib
+  from tensor2robot_tpu.telemetry import sentinel as sentinel_lib
+  from tensor2robot_tpu.utils import profiling
+  perf_lib.start_resource_sampler(
+      sources=[profiling.device_memory_source()])
+  watch_sentinel = sentinel_lib.build_for_run(model_dir)
 
   if replay_buffer is None:
     replay_buffer = ReplayBuffer(learner.transition_specification())
@@ -178,6 +188,16 @@ def train_qtopt(
   writer = ckpt_lib.CheckpointWriter(
       model_dir, max_to_keep=max_checkpoints_to_keep)
 
+  # Live MFU attribution: the SAME analytic denominator bench.py uses
+  # (utils.profiling.analytic_flops — the ISSUE-15 shared-path pin),
+  # scaled to the mesh (batch_size is the GLOBAL batch; peak × devices
+  # keeps perf.mfu the per-chip fraction).
+  perf_meter = perf_lib.PerfMeter(
+      flops_per_step=profiling.qtopt_step_flops(
+          learner, batch_size, params=state.train_state.params),
+      peak_flops=profiling.device_peak_flops(),
+      devices=mesh.size)
+
   if k == 1:
     train_step = jax.jit(
         learner.train_step,
@@ -230,7 +250,7 @@ def train_qtopt(
     for transitions in prefetch_iter:
       if step >= max_train_steps:
         break
-      with telemetry.span("qtopt.dispatch", step=step, k=k):
+      with perf_meter.dispatch("qtopt.dispatch", step=step, k=k):
         if k == 1:
           state, metrics = train_step(
               state, transitions, jax.random.fold_in(step_rng, step))
@@ -258,9 +278,20 @@ def train_qtopt(
         # Compile-cache counters from the telemetry registry: a miss
         # delta after the first interval is a warm-path recompile.
         scalars.update(telemetry.registry().scalars("compile_cache."))
+        # Resource watermarks persist with the run (the report tool's
+        # watermark section; the registry alone dies with the process).
+        scalars.update(telemetry.registry().scalars("rsrc."))
         telemetry.registry().gauge("train.grad_steps_per_sec").set(
             scalars["grad_steps_per_sec"])
+        # Live utilization (perf.mfu / flops_per_sec /
+        # device_time_fraction) — same denominator as bench MFU.
+        scalars.update(perf_meter.publish(
+            scalars["grad_steps_per_sec"], dt))
         metric_logger.write("train", step, scalars)
+        if watch_sentinel is not None:
+          watch_sentinel.evaluate(
+              {**telemetry.registry().scalars(), **scalars},
+              step=step)
         t_last = time.time()
         steps_since_log = 0
       if step % save_checkpoints_steps == 0 or step == max_train_steps:
@@ -285,5 +316,7 @@ def train_qtopt(
       log.exception("hook end() failed during teardown")
     prefetcher.close()
     writer.close()
+    if watch_sentinel is not None:
+      watch_sentinel.close()
     metric_logger.close()
   return state

@@ -85,7 +85,8 @@ class _Tenant:
 
   __slots__ = ("tenant", "queue", "carry", "loading", "rng",
                "tm_request_ms", "tm_completions", "tm_slo_ok",
-               "tm_queue_depth")
+               "tm_queue_depth", "tm_goodput", "goodput_rows",
+               "goodput_t0")
 
   def __init__(self, tenant: str, max_queue: int, seed: int,
                takes_rng: bool):
@@ -105,6 +106,14 @@ class _Tenant:
     self.tm_slo_ok = tmetrics.counter(f"serving.{tenant}.slo_ok")
     self.tm_queue_depth = tmetrics.gauge(
         f"serving.{tenant}.queue_depth")
+    # Live goodput (ISSUE 15): in-SLO completed ROWS per second over a
+    # rolling window, derived from the same completion accounting the
+    # slo_ok counter rides — renders with a tenant= label like every
+    # serving.<tenant>.* name.
+    self.tm_goodput = tmetrics.gauge(
+        f"serving.{tenant}.goodput_rows_per_sec")
+    self.goodput_rows = 0.0
+    self.goodput_t0 = time.perf_counter()
 
   def pending(self) -> bool:
     return self.carry is not None or not self.queue.empty()
@@ -155,6 +164,11 @@ class ServingFront:
     self.dispatches = 0
     self.requests = 0
     self.dispatches_per_tenant: Dict[str, int] = {}
+    # Front-wide live goodput window (in-SLO rows/s across tenants);
+    # per-tenant windows live on each _Tenant entry. Dispatcher-thread
+    # state only — no lock.
+    self._goodput_rows = 0.0
+    self._goodput_t0 = time.perf_counter()
     self._thread = threading.Thread(
         target=self._run, name="serving-front", daemon=True)
     self._thread.start()
@@ -360,9 +374,12 @@ class ServingFront:
         continue
       try:
         # Idle: park on the wakeup flag. A stale flag costs one empty
-        # scan — never a lost request, never a busy spin.
+        # scan — never a lost request, never a busy spin. The idle
+        # tick also rolls the goodput windows so gauges decay honestly
+        # through quiet stretches.
         self._work.get(timeout=0.05)
       except queue.Empty:
+        self._roll_goodput_windows()
         continue
 
   def _serve_round(self) -> bool:
@@ -398,6 +415,31 @@ class ServingFront:
     self._dispatch(entry, batch, engine)
     return True  # queue entries were consumed either way
 
+  _GOODPUT_WINDOW_SECS = 1.0
+
+  def _roll_goodput_windows(self, now: Optional[float] = None) -> None:
+    """Closes every goodput window that has run ≥1 s — per tenant and
+    front-wide — publishing rows/window (0 when nothing completed).
+    Called after each completion batch AND from the dispatcher's idle
+    tick, so windows keep rolling through quiet stretches: an idle
+    tenant's gauge decays to 0 within ~a window instead of freezing at
+    its last burst, and a burst after a long gap is denominated over
+    ~one window, not the whole gap. Dispatcher-thread only."""
+    if now is None:
+      now = time.perf_counter()
+    for entry in list(self._tenants.values()):
+      window = now - entry.goodput_t0
+      if window >= self._GOODPUT_WINDOW_SECS:
+        entry.tm_goodput.set(entry.goodput_rows / window)
+        entry.goodput_rows = 0.0
+        entry.goodput_t0 = now
+    window = now - self._goodput_t0
+    if window >= self._GOODPUT_WINDOW_SECS:
+      tmetrics.gauge("perf.goodput_rows_per_sec").set(
+          self._goodput_rows / window)
+      self._goodput_rows = 0.0
+      self._goodput_t0 = now
+
   def _dispatch(self, entry: _Tenant, batch: List[_Request],
                 engine: Any) -> None:
     # Claim first (shared coalesce contract): requests cancelled while
@@ -430,6 +472,9 @@ class ServingFront:
         entry.tm_completions.inc()
         if latency_ms <= slo_ms:
           entry.tm_slo_ok.inc()
+          entry.goodput_rows += request.n
+          self._goodput_rows += request.n
+      self._roll_goodput_windows(done)
       coalesce.deliver(batch, outputs)
     except Exception as exc:  # noqa: BLE001 — deliver to every caller
       coalesce.fail_batch(batch, exc)

@@ -19,6 +19,14 @@ Three legs (ISSUE 11, docs/OBSERVABILITY.md):
 `records` defines the unified `metrics_<tag>.jsonl` envelope
 (``{step, wall, role, payload}``) and its one reader.
 
+The always-on performance plane (ISSUE 15) rides the same three legs:
+`perf` (live MFU attribution on bench's analytic denominator +
+`rsrc.*` resource watermarks from a per-role sampler thread),
+`sentinel` (gin-configurable watch rules over the registry's scalar
+view, alert events/counters/`alerts.jsonl`, page severity → flight
+records), and `report` (``python -m tensor2robot_tpu.telemetry.report``
+— one markdown page per run dir).
+
 The whole package is jax-free BY CONTRACT: fleet actors and data-plane
 workers import it at spawn (IMP401 worker-safe set; subprocess-pinned
 by tests/test_telemetry.py).
@@ -28,8 +36,11 @@ from tensor2robot_tpu.telemetry import core
 from tensor2robot_tpu.telemetry import flightrec
 from tensor2robot_tpu.telemetry import merge
 from tensor2robot_tpu.telemetry import metrics
+from tensor2robot_tpu.telemetry import perf
 from tensor2robot_tpu.telemetry import prometheus
 from tensor2robot_tpu.telemetry import records
+from tensor2robot_tpu.telemetry import report
+from tensor2robot_tpu.telemetry import sentinel
 from tensor2robot_tpu.telemetry.core import (
     clock_offset_from_handshake,
     configure,
@@ -50,8 +61,11 @@ __all__ = [
     "get_tracer",
     "merge",
     "metrics",
+    "perf",
     "prometheus",
     "records",
     "registry",
+    "report",
+    "sentinel",
     "span",
 ]

@@ -103,7 +103,14 @@ def merge_traces(trace_dir: str,
   # MERGED ORDER: one timeline, host-clock sorted — the property the
   # cross-process ordering test pins.
   timed.sort(key=lambda e: e["ts"])
+  # RPC flow synthesis (ISSUE 15): an rpc_call.<m> span and the
+  # rpc.<m> handler span sharing a client-stamped `req` id become one
+  # Perfetto flow — the arrow from the caller's wait to the host's
+  # handler work. Offsets were already applied per meta-line above, so
+  # flows inherit the same per-file-offset awareness.
+  flows = _rpc_flow_events(timed)
   events.extend(timed)
+  events.extend(flows)
   span_counts: Dict[str, int] = {}
   for event in timed:
     span_counts[event["cat"]] = span_counts.get(event["cat"], 0) + 1
@@ -111,6 +118,7 @@ def merge_traces(trace_dir: str,
       "traceEvents": events,
       "displayTimeUnit": "ms",
       "metadata": {
+          "rpc_flows": len(flows) // 2,
           # `roles` = every role SEEN (a meta line counts: the process
           # configured tracing); `span_counts_by_role` is the stronger
           # fact — a role that configured but never recorded shows 0,
@@ -133,6 +141,39 @@ def merge_traces(trace_dir: str,
       with open(out_path, "w") as f:
         json.dump(trace, f)
   return trace
+
+
+def _rpc_flow_events(timed: List[Dict[str, Any]]
+                     ) -> List[Dict[str, Any]]:
+  """Chrome-trace flow event pairs linking rpc_call.<m> (client span,
+  flow start) to rpc.<m> (server handler span, flow end) by the
+  client-stamped ``args.req`` id (fleet/rpc.py). A retried call whose
+  first send was dropped has a client span with no handler twin (or
+  vice versa after a crash) — unpaired ids emit nothing."""
+  starts: Dict[str, Dict[str, Any]] = {}
+  ends: Dict[str, Dict[str, Any]] = {}
+  for event in timed:
+    req = (event.get("args") or {}).get("req")
+    if not req:
+      continue
+    name = event.get("name", "")
+    if name.startswith("rpc_call.") and req not in starts:
+      starts[req] = event
+    elif name.startswith("rpc.") and req not in ends:
+      ends[req] = event
+  flows: List[Dict[str, Any]] = []
+  for index, (req, start) in enumerate(sorted(starts.items())):
+    end = ends.get(req)
+    if end is None:
+      continue
+    method = start["name"][len("rpc_call."):]
+    base = {"name": f"rpc:{method}", "cat": "rpc_flow",
+            "id": index + 1}
+    flows.append({**base, "ph": "s", "ts": start["ts"],
+                  "pid": start["pid"], "tid": start["tid"]})
+    flows.append({**base, "ph": "f", "bp": "e", "ts": end["ts"],
+                  "pid": end["pid"], "tid": end["tid"]})
+  return flows
 
 
 def roles_in(trace: Dict[str, Any]) -> List[str]:

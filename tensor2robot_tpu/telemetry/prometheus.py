@@ -186,3 +186,18 @@ def serve(port: int = 0, host: str = "127.0.0.1",
   """Starts (and returns) the scrape endpoint; `port=0` picks a free
   one (read it back from ``.port``)."""
   return PrometheusEndpoint(port=port, host=host, prefix=prefix)
+
+
+def default_port(port: Optional[int] = None) -> Optional[int]:
+  """The gin-backed default for `run_t2r_trainer --prometheus_port`
+  (ISSUE 15): bind ``default_port.port`` in a config to start the
+  scrape endpoint in ANY trainer/fleet process without passing the
+  flag (0 = ephemeral port, None = off)."""
+  return port
+
+
+# Registered at import (the config engine is jax-free — it already
+# rides the telemetry package import via the sentinel's watches).
+from tensor2robot_tpu import config as _gin  # noqa: E402
+
+default_port = _gin.configurable(default_port)

@@ -453,6 +453,29 @@ def train_eval_model(
   else:
     train_callable, eval_callable = train_step, eval_step
 
+  # The always-on perf plane (ISSUE 15): resource sampler + sentinel
+  # per process, and live MFU attribution at log cadence. The generic
+  # trainer has no analytic model-flops formula (arbitrary models), so
+  # the denominator is XLA's cost analysis of the AOT-compiled train
+  # program (÷ K for the scanned dispatch) — approximate but stable
+  # for the run; absent (lazy-jit fallback), perf.mfu is simply not
+  # published and device_time_fraction still is.
+  from tensor2robot_tpu.telemetry import perf as perf_lib
+  from tensor2robot_tpu.telemetry import sentinel as sentinel_lib
+  from tensor2robot_tpu.utils import profiling
+  perf_lib.start_resource_sampler(
+      sources=[profiling.device_memory_source()])
+  watch_sentinel = sentinel_lib.build_for_run(model_dir)
+  train_flops = None
+  if aot and aot.get("train") is not None:
+    flops_per_call = profiling.compiled_flops_per_call(aot["train"])
+    if flops_per_call:
+      train_flops = flops_per_call / k
+  perf_meter = perf_lib.PerfMeter(
+      flops_per_step=train_flops,
+      peak_flops=profiling.device_peak_flops(),
+      devices=mesh.size)
+
   final_metrics: Dict[str, Any] = {}
   try:
     # Inside the try: with overlapped startup the prefetcher is
@@ -482,7 +505,7 @@ def train_eval_model(
       for features, labels in prefetch_iter:
         if step >= max_train_steps:
           break
-        with telemetry.span("train.dispatch", step=step):
+        with perf_meter.dispatch("train.dispatch", step=step):
           if k == 1:
             state, metrics = train_callable(
                 state, features, labels,
@@ -507,15 +530,26 @@ def train_eval_model(
           # tap publishes into the registry): a nonzero miss delta
           # AFTER the first interval is a warm-path recompile.
           scalars.update(telemetry.registry().scalars("compile_cache."))
+          # Resource watermarks persist with the run (the report
+          # tool's watermark section reads them back).
+          scalars.update(telemetry.registry().scalars("rsrc."))
           telemetry.registry().gauge("train.steps_per_sec").set(
               scalars["steps_per_sec"])
           telemetry.registry().gauge("train.stall_fraction").set(
               scalars["stall_fraction"])
+          # Live utilization (perf.mfu / flops_per_sec /
+          # device_time_fraction): the always-on perf plane.
+          scalars.update(perf_meter.publish(
+              scalars["steps_per_sec"], dt))
           final_metrics = scalars
           t_last = time.time()
           steps_since_log = 0
           t_write = time.perf_counter()
           metric_logger.write("train", step, scalars)
+          if watch_sentinel is not None:
+            watch_sentinel.evaluate(
+                {**telemetry.registry().scalars(), **scalars},
+                step=step)
           # The write itself is logging stall, charged to the
           # interval that just began.
           stall_secs = time.perf_counter() - t_write
@@ -569,6 +603,8 @@ def train_eval_model(
     if train_prefetcher is not None:
       train_prefetcher.close()
     writer.close()
+    if watch_sentinel is not None:
+      watch_sentinel.close()
     metric_logger.close()
   return state
 

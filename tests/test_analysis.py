@@ -840,6 +840,81 @@ class TestCli:
 
 
 # ---------------------------------------------------------------------------
+# Observability hygiene: OBS501 metric-catalog lint (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+class TestObsRules:
+
+  CATALOG = """\
+  # catalog fixture
+  | `replay.adds` | counter | rows |
+  | `fleet.rpc.{timeouts,retries}` | counter | ledger |
+  | `serving.<tenant>.request_ms` | histogram | latency |
+  | prose mentioning a bare `<rest>` placeholder |
+  """
+
+  def _run(self, tmp_path, code, catalog=None):
+    from tensor2robot_tpu.analysis.obs_rules import run_obs_rules
+    _write(tmp_path, "mod.py", code)
+    catalog_path = _write(tmp_path, "CATALOG.md",
+                          catalog if catalog is not None
+                          else self.CATALOG)
+    return run_obs_rules([str(tmp_path / "mod.py")], str(tmp_path),
+                         catalog_path=catalog_path)
+
+  def test_undocumented_literal_positive(self, tmp_path):
+    found = self._run(tmp_path, """
+        from tensor2robot_tpu.telemetry import metrics as tmetrics
+        tmetrics.counter("replay.undocumented_total").inc()
+        """)
+    assert _rules(found) == {"OBS501"}
+    assert "replay.undocumented_total" in found[0].message
+
+  def test_documented_brace_and_placeholder_negative(self, tmp_path):
+    found = self._run(tmp_path, """
+        from tensor2robot_tpu.telemetry import metrics as tmetrics
+        tmetrics.counter("replay.adds").inc()
+        tmetrics.counter("fleet.rpc.retries").inc()
+        tmetrics.histogram("serving.tenant_a.request_ms").observe(1.0)
+        """)
+    assert found == [], [f.render() for f in found]
+
+  def test_bare_placeholder_never_blinds_the_rule(self, tmp_path):
+    # The fixture catalog contains a bare `<rest>` in prose; it must
+    # NOT compile into a match-everything wildcard.
+    found = self._run(tmp_path, """
+        from tensor2robot_tpu.telemetry import metrics as tmetrics
+        tmetrics.gauge("anything.at_all").set(1.0)
+        """)
+    assert _rules(found) == {"OBS501"}
+
+  def test_undotted_helper_strings_ignored(self, tmp_path):
+    found = self._run(tmp_path, """
+        class Thing:
+          def counter(self, name):
+            return name
+        Thing().counter("not_a_metric")
+        """)
+    assert found == []
+
+  def test_missing_catalog_is_a_finding(self, tmp_path):
+    from tensor2robot_tpu.analysis.obs_rules import run_obs_rules
+    _write(tmp_path, "mod.py", "x = 1\n")
+    found = run_obs_rules([str(tmp_path)], str(tmp_path),
+                          catalog_path=str(tmp_path / "missing.md"))
+    assert _rules(found) == {"OBS501"}
+    assert "catalog missing" in found[0].message
+
+  def test_repo_is_clean(self):
+    # The shipped contract: every literal metric name in the package
+    # is documented in docs/OBSERVABILITY.md (baseline stays EMPTY).
+    from tensor2robot_tpu.analysis.obs_rules import run_obs_rules
+    package = os.path.join(REPO_ROOT, "tensor2robot_tpu")
+    found = run_obs_rules([package], REPO_ROOT)
+    assert found == [], [f.render() for f in found]
+
+
+# ---------------------------------------------------------------------------
 # Gin static validation (imports the framework: the one heavy family)
 # ---------------------------------------------------------------------------
 
