@@ -1529,31 +1529,191 @@ def _run_coldstart_probe(kind: str, model_dir: str,
   return result
 
 
-def bench_fleet(dry_run: bool = False):
-  """The --fleet axis: a REAL multi-process Podracer run on this host.
+def _bench_wire_serialization(tiny: bool = False):
+  """The wire microbench: in-band pickle vs out-of-band protocol-5
+  frames over a REAL connected TCP socket pair, per payload size.
 
-  Topology (docs/FLEET.md): 2 jax-free actor processes (GraspActor
+  The in-band leg is the loopback transport's exact strategy (one
+  `pickle.dumps` stream carrying the array bytes, length-prefixed,
+  `pickle.loads` on the far side — what `multiprocessing.Connection`
+  does); the out-of-band leg is `fleet/transport.py`'s framed
+  `TcpConnection` (arrays stay OUT of the pickle stream, gather-sent
+  straight from their own memory, received straight into their final
+  backing store). Same kernel path both legs, so the delta is the
+  serialization strategy alone. Copies are COUNTED, not asserted: the
+  connection's `last_{send,recv}_oob_copies` instrumentation plus an
+  `np.shares_memory` probe on the first decoded array prove the
+  out-of-band leg's ≤1-copy-per-side contract; the in-band leg pays
+  one full extra payload copy per side by construction (dumps into
+  the stream, loads back out).
+  """
+  import pickle
+  import socket as socket_lib
+  import struct
+  import threading
+
+  from tensor2robot_tpu.fleet import transport as wire
+
+  def _tcp_pair():
+    lst = socket_lib.socket(socket_lib.AF_INET, socket_lib.SOCK_STREAM)
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(1)
+    client = socket_lib.create_connection(lst.getsockname()[:2])
+    server, _ = lst.accept()
+    lst.close()
+    for sock in (client, server):
+      sock.setsockopt(socket_lib.IPPROTO_TCP, socket_lib.TCP_NODELAY, 1)
+    return server, client
+
+  sizes = (1,) if tiny else (1, 8, 32)
+  reps = 4 if tiny else 12
+  trials = 1 if tiny else 2  # best-of: TCP slow-start/scheduling jitter
+  rows = []
+  for mib in sizes:
+    arr = np.arange(mib * (1 << 20) // 4, dtype=np.float32)
+    payload = {"step": 7, "params": arr}
+    payload_bytes = arr.nbytes * reps
+
+    def _in_band_trial():
+      # One pickle stream, arrays inside it (the loopback strategy).
+      server, client = _tcp_pair()
+
+      def _send():
+        for _ in range(reps):
+          body = pickle.dumps(payload, protocol=5)
+          client.sendall(struct.pack("<Q", len(body)) + body)
+
+      t0 = time.perf_counter()
+      sender = threading.Thread(target=_send, daemon=True)
+      sender.start()
+      got = None
+      for _ in range(reps):
+        head = bytearray(8)
+        view = memoryview(head)
+        filled = 0
+        while filled < 8:
+          filled += server.recv_into(view[filled:])
+        (length,) = struct.unpack("<Q", head)
+        body = bytearray(length)
+        view = memoryview(body)
+        filled = 0
+        while filled < length:
+          filled += server.recv_into(view[filled:])
+        got = pickle.loads(bytes(body))
+      sender.join()
+      secs = time.perf_counter() - t0
+      assert np.array_equal(got["params"], arr)
+      server.close()
+      client.close()
+      return secs
+
+    def _oob_trial():
+      # The fleet wire frame: protocol-5 out-of-band buffers.
+      raw_server, raw_client = _tcp_pair()
+      conn_send = wire.TcpConnection(raw_client)
+      conn_recv = wire.TcpConnection(raw_server, track_buffers=True)
+
+      def _send():
+        for _ in range(reps):
+          conn_send.send(payload)
+
+      t0 = time.perf_counter()
+      sender = threading.Thread(target=_send, daemon=True)
+      sender.start()
+      shares = None
+      got = None
+      for _ in range(reps):
+        got = conn_recv.recv()
+        if shares is None:
+          # The decoded array IS a view of the recv_into target — the
+          # kernel→user read was the payload's only copy this side.
+          shares = bool(conn_recv.last_recv_buffers) and any(
+              np.shares_memory(got["params"], np.frombuffer(
+                  buf, dtype=np.uint8))
+              for buf in conn_recv.last_recv_buffers)
+      sender.join()
+      secs = time.perf_counter() - t0
+      assert np.array_equal(got["params"], arr)
+      copies = (conn_send.last_send_oob_copies,
+                conn_recv.last_recv_oob_copies)
+      conn_send.close()
+      conn_recv.close()
+      return secs, shares, copies
+
+    in_band_secs = min(_in_band_trial() for _ in range(trials))
+    oob_runs = [_oob_trial() for _ in range(trials)]
+    oob_secs = min(run[0] for run in oob_runs)
+    shares = oob_runs[0][1]
+    send_copies, recv_copies = oob_runs[0][2]
+
+    mb = payload_bytes / (1 << 20)
+    rows.append({
+        "payload_mib": mib,
+        "reps": reps,
+        "trials": trials,
+        "in_band_mb_per_sec": round(mb / in_band_secs, 1),
+        "oob_mb_per_sec": round(mb / oob_secs, 1),
+        "oob_speedup": round(in_band_secs / oob_secs, 2),
+        "oob_send_payload_copies": send_copies,
+        "oob_recv_payload_copies": recv_copies,
+        "oob_decoded_array_shares_recv_memory": shares,
+        "in_band_payload_copies_per_side": 1,
+    })
+  return {
+      "payloads": rows,
+      "note": (
+          "same TCP socket path both legs; in-band = the loopback "
+          "strategy (arrays inside one pickle stream, 1 extra payload "
+          "copy per side), oob = fleet/transport.py frames (protocol-"
+          "5 out-of-band buffers, 0 extra copies per side — counted "
+          "by the connection and proven by np.shares_memory)"),
+  }
+
+
+def bench_fleet(dry_run: bool = False):
+  """The --fleet axis: REAL multi-process Podracer runs on this host.
+
+  Topology (docs/FLEET.md): jax-free actor processes (GraspActor
   driving MuJoCoPoseEnv through the PoseGraspBandit adapter) pull
-  actions from, and commit atomic episodes into, one replay/serving
-  host process (CEMPolicyServer + ReplayWriteService/ReplayStore); a
-  learner process runs train_qtopt on the host's store and publishes
-  each checkpoint's params back into the serving engine, stamped with
+  actions from, and commit atomic episodes into, the replay/serving
+  plane (CEMPolicyServer + ReplayWriteService/ReplayStore); a
+  learner process runs train_qtopt on the store and publishes
+  each checkpoint's params back into the serving engines, stamped with
   the learner step. The orchestrator supervises all of it, and the
-  shipped qtopt_fleet.gin rides through `run_t2r_trainer
-  --validate_only` as the pre-spawn launch gate, so the gate path is
-  exercised on every bench run.
+  shipped gin files ride through `run_t2r_trainer --validate_only` as
+  the pre-spawn launch gates, so the gate path is exercised on every
+  bench run (qtopt_fleet.gin for the loopback leg, qtopt_fleet_tcp.gin
+  for every TCP leg).
+
+  Four legs (docs/FLEET.md §"Cross-host fleets"):
+    * the wire microbench — in-band pickle vs out-of-band protocol-5
+      framing over a real socket pair, MB/s + copies counted;
+    * the committed single-host loopback baseline (the headline
+      numbers, shape-stable since the axis first shipped);
+    * the loopback-vs-TCP head-to-head — the SAME single-host
+      topology with every RPC riding fleet/transport.py frames;
+    * the cross-host TCP legs — 2 serving hosts + 2 replay shard
+      hosts on real ports, at 2 and 4 actors, with per-hop
+      param_refresh_lag and shard-namespaced staleness.
+
+  The bench REFUSES TO COMMIT (SystemExit before any detail write)
+  unless the out-of-band wire is >= 2x the in-band rate at every
+  payload >= 8 MiB, and the same-host TCP leg holds >= 85% of the
+  loopback leg's collection throughput measured in the same run.
 
   Measured end-to-end (not per-organ): committed env transitions/s
   over the commit window, learner grad-steps/s over the learner-step
   window, the param_refresh_lag distribution (learner step at commit
-  minus at the publication the actor acted with), and the replay
-  staleness histogram of the batches the learner actually trained on.
-  `dry_run`: tiny model/short run, NO detail-file write — the tier-1
-  smoke. The real run uses a BENCH-tuned FleetConfig: the shipped
-  qtopt_fleet.gin's model/topology scale, but a shorter run
-  (240 steps, 40-step cadence vs the config's 500/50) so the axis
-  fits a bench budget — the shipped file itself is exercised as the
-  launch gate, not as the measured config.
+  minus at the publication the actor acted with; per broadcast hop on
+  cross-host legs), and the replay staleness histogram of the batches
+  the learner actually trained on. `dry_run`: tiny model/short runs
+  (loopback + a tiny cross-host TCP leg + the tiny wire microbench),
+  NO detail-file write — the tier-1 smoke. The real run uses a
+  BENCH-tuned FleetConfig: the shipped gin files' model/topology
+  scale, but a shorter run (240 steps, 40-step cadence vs the
+  configs' 500/50) so the axis fits a bench budget — the shipped
+  files themselves are exercised as launch gates, not as the measured
+  config.
   """
   import shutil
   import tempfile
@@ -1561,69 +1721,156 @@ def bench_fleet(dry_run: bool = False):
   from tensor2robot_tpu.fleet import Fleet, FleetConfig
 
   tiny = dry_run
-  config = FleetConfig(
-      num_actors=2,
-      env="mujoco_pose",
-      image_size=16 if tiny else 32,
-      action_dim=2,
-      torso_filters=(8,) if tiny else (16, 32),
-      head_filters=(8,) if tiny else (32, 32),
-      dense_sizes=(16,) if tiny else (32, 32),
-      cem_population=8 if tiny else 64,
-      cem_iterations=1 if tiny else 2,
-      cem_elites=2 if tiny else 6,
-      batch_size=16 if tiny else 64,
-      max_train_steps=24 if tiny else 240,
-      min_replay_size=32 if tiny else 128,
-      publish_every_steps=8 if tiny else 40,
-      log_every_steps=8 if tiny else 40,
-      batch_episodes=8 if tiny else 16,
-      serve_max_batch=4 if tiny else 8,
-      replay_capacity=512 if tiny else 4096,
-      replay_shards=2,
-      heartbeat_timeout_secs=0.0 if tiny else 300.0,
-      launch_timeout_secs=240.0,
-      run_timeout_secs=600.0 if tiny else 1500.0,
-      seed=0)
-  gate_config = os.path.join(
+  configs_dir = os.path.join(
       os.path.dirname(os.path.abspath(__file__)), "tensor2robot_tpu",
-      "research", "qtopt", "configs", "qtopt_fleet.gin")
-  model_dir = tempfile.mkdtemp(prefix="t2r_fleet_bench_")
-  try:
-    fleet = Fleet(config, model_dir, gin_configs=(gate_config,))
-    result = fleet.run()
-  finally:
-    shutil.rmtree(model_dir, ignore_errors=True)
-  staleness = {
-      batch: {k: snap[k] for k in ("mean_age_steps", "max_age_steps",
-                                   "batch_mean_age_p95_steps", "rows")}
-      for batch, snap in result.replay_staleness.items()
-      if snap}
-  service = result.metrics.get("service", {})
+      "research", "qtopt", "configs")
+  loopback_gate = os.path.join(configs_dir, "qtopt_fleet.gin")
+  tcp_gate = os.path.join(configs_dir, "qtopt_fleet_tcp.gin")
+
+  def _config(transport="loopback", num_actors=2, serving_hosts=1,
+              replay_hosts=0):
+    return FleetConfig(
+        num_actors=num_actors,
+        env="mujoco_pose",
+        image_size=16 if tiny else 32,
+        action_dim=2,
+        torso_filters=(8,) if tiny else (16, 32),
+        head_filters=(8,) if tiny else (32, 32),
+        dense_sizes=(16,) if tiny else (32, 32),
+        cem_population=8 if tiny else 64,
+        cem_iterations=1 if tiny else 2,
+        cem_elites=2 if tiny else 6,
+        batch_size=16 if tiny else 64,
+        max_train_steps=24 if tiny else 240,
+        min_replay_size=32 if tiny else 128,
+        publish_every_steps=8 if tiny else 40,
+        log_every_steps=8 if tiny else 40,
+        batch_episodes=8 if tiny else 16,
+        serve_max_batch=4 if tiny else 8,
+        replay_capacity=512 if tiny else 4096,
+        replay_shards=2,
+        transport=transport,
+        serving_hosts=serving_hosts,
+        replay_hosts=replay_hosts,
+        broadcast_degree=2,
+        heartbeat_timeout_secs=0.0 if tiny else 300.0,
+        launch_timeout_secs=240.0,
+        run_timeout_secs=600.0 if tiny else 1500.0,
+        seed=0)
+
+  def _run_leg(config, gate_config):
+    model_dir = tempfile.mkdtemp(prefix="t2r_fleet_bench_")
+    try:
+      fleet = Fleet(config, model_dir, gin_configs=(gate_config,))
+      return fleet.run()
+    finally:
+      shutil.rmtree(model_dir, ignore_errors=True)
+
+  def _section(config, result):
+    staleness = {
+        batch: {k: snap[k] for k in ("mean_age_steps", "max_age_steps",
+                                     "batch_mean_age_p95_steps",
+                                     "rows")
+                if k in snap}
+        for batch, snap in result.replay_staleness.items()
+        if snap}
+    service = result.metrics.get("service") or {}
+    section = {
+        "transport": config.transport,
+        "num_actors": config.num_actors,
+        "serving_hosts": config.serving_hosts,
+        "replay_shard_hosts": config.replay_hosts,
+        "env_steps_per_sec": round(result.env_steps_per_sec, 1),
+        "learner_steps_per_sec": round(result.learner_steps_per_sec,
+                                       2),
+        "param_refresh_lag": result.param_refresh_lag,
+        "replay_staleness": staleness,
+        "publishes": result.publishes,
+        "params_version": result.params_version,
+        "actor_restarts": result.actor_restarts,
+        "dropped_batches": service.get("replay_dropped_batches"),
+        "committed_transitions": service.get(
+            "replay_committed_transitions"),
+        "wall_secs": round(result.wall_secs, 1),
+        "clean_shutdown": result.clean_shutdown,
+    }
+    if config.serving_hosts > 1:
+      section["broadcast_degree"] = config.broadcast_degree
+    return section
+
+  wire = _bench_wire_serialization(tiny=tiny)
+  for row in wire["payloads"]:
+    if row["payload_mib"] >= 8 and row["oob_speedup"] < 2.0:
+      raise SystemExit(
+          f"wire microbench gate FAILED: out-of-band framing is only "
+          f"{row['oob_speedup']}x the in-band pickle rate at "
+          f"{row['payload_mib']} MiB (need >= 2x); refusing to "
+          f"commit.\n{json.dumps(wire, indent=2)}")
+
+  loopback_config = _config()
+  loopback = _section(loopback_config,
+                      _run_leg(loopback_config, loopback_gate))
+
+  # Head-to-head: the IDENTICAL single-host topology, every RPC on the
+  # socket transport. Gated against the loopback leg measured seconds
+  # ago in this very run (config-matched, load-matched) — the honest
+  # "cost of real sockets on one host". Full runs only: tiny-run
+  # throughput is too noisy to gate, and the tier-1 budget buys the
+  # cross-host TCP smoke below instead.
+  tcp_same_host = None
+  if not tiny:
+    tcp_config = _config(transport="tcp")
+    tcp_same_host = _section(tcp_config,
+                             _run_leg(tcp_config, tcp_gate))
+    tcp_fraction = round(
+        tcp_same_host["env_steps_per_sec"]
+        / max(loopback["env_steps_per_sec"], 1e-9), 3)
+    tcp_same_host["fraction_of_loopback"] = tcp_fraction
+    if tcp_fraction < 0.85:
+      raise SystemExit(
+          f"loopback-vs-TCP gate FAILED: same-host TCP collected "
+          f"{tcp_same_host['env_steps_per_sec']} env-steps/s vs "
+          f"loopback {loopback['env_steps_per_sec']} "
+          f"({tcp_fraction} < 0.85); refusing to commit.")
+
+  # Cross-host TCP: 2 serving hosts + 2 replay shard hosts on real
+  # ports; the dry run keeps ONE tiny cross-host leg so tier-1 smokes
+  # the whole topology end to end.
+  cross_host = {}
+  for actors in ((2,) if tiny else (2, 4)):
+    cross_config = _config(transport="tcp", num_actors=actors,
+                           serving_hosts=2, replay_hosts=2)
+    cross_host[f"actors_{actors}"] = _section(
+        cross_config, _run_leg(cross_config, tcp_gate))
+
   return {
       "device_kind": jax.devices()[0].device_kind,
       "host_cores": os.cpu_count(),
-      "num_actors": config.num_actors,
-      "env": config.env,
+      "num_actors": loopback_config.num_actors,
+      "env": loopback_config.env,
       "launch_gate": "run_t2r_trainer --validate_only (passed)",
-      "env_steps_per_sec": round(result.env_steps_per_sec, 1),
-      "learner_steps_per_sec": round(result.learner_steps_per_sec, 2),
-      "param_refresh_lag": result.param_refresh_lag,
-      "replay_staleness": staleness,
-      "publishes": result.publishes,
-      "params_version": result.params_version,
-      "actor_restarts": result.actor_restarts,
-      "dropped_batches": service.get("replay_dropped_batches"),
-      "committed_transitions": service.get(
-          "replay_committed_transitions"),
-      "wall_secs": round(result.wall_secs, 1),
-      "clean_shutdown": result.clean_shutdown,
+      "env_steps_per_sec": loopback["env_steps_per_sec"],
+      "learner_steps_per_sec": loopback["learner_steps_per_sec"],
+      "param_refresh_lag": loopback["param_refresh_lag"],
+      "replay_staleness": loopback["replay_staleness"],
+      "publishes": loopback["publishes"],
+      "params_version": loopback["params_version"],
+      "actor_restarts": loopback["actor_restarts"],
+      "dropped_batches": loopback["dropped_batches"],
+      "committed_transitions": loopback["committed_transitions"],
+      "wall_secs": loopback["wall_secs"],
+      "clean_shutdown": loopback["clean_shutdown"],
+      "wire_serialization": wire,
+      "tcp_same_host": tcp_same_host,
+      "cross_host_tcp": cross_host,
       "note": (
-          "real multi-process run on this host: every organ crossed a "
-          "process boundary (actions via the host's micro-batched AOT "
-          "engine, episodes via atomic replay sessions, params via "
-          "learner-step-stamped hot-swap publications); lag/staleness "
-          "are in learner steps"),
+          "real multi-process runs on this host: every organ crossed "
+          "a process boundary (actions via the host's micro-batched "
+          "AOT engine, episodes via atomic replay sessions, params "
+          "via learner-step-stamped hot-swap publications); "
+          "lag/staleness are in learner steps; headline numbers are "
+          "the single-host loopback leg (the axis' committed shape), "
+          "TCP legs ride fleet/transport.py end to end"),
   }
 
 
@@ -1641,7 +1888,9 @@ def bench_chaos(dry_run: bool = False):
   engine; the respawn restores from the latest checkpoint), RPC
   requests delayed and dropped client-side (deadline + retry), the
   host stalled and force-disconnecting server-side — plus an elastic
-  `scale_to(3)` → `scale_to(2)` leg mid-run. The shipped
+  `scale_to(3)` → `scale_to(2)` leg mid-run. The whole schedule runs
+  over `transport="tcp"` (the real socket wire), proving the
+  recovery contract is transport-blind. The shipped
   qtopt_fleet_elastic.gin rides through `--validate_only` as the
   launch gate.
 
@@ -1733,6 +1982,13 @@ def bench_chaos(dry_run: bool = False):
       rpc_call_timeout_secs=3.0 if tiny else 5.0,
       rpc_max_retries=3,
       telemetry_poll_secs=1.0,  # the spike-and-settle series cadence
+      # Chaos rides the REAL SOCKET TRANSPORT: every fault class is
+      # injected and every one of the nine recovery gates below must
+      # hold with the RPC plane on fleet/transport.py frames instead
+      # of the loopback pipe (the fault seams live above the
+      # transport, so the plan replays identically — pinned by
+      # tests/test_fleet_transport.py's digest-parity test).
+      transport="tcp",
       fault_plan=plan,
       launch_timeout_secs=240.0,
       run_timeout_secs=900.0 if tiny else 1800.0,
@@ -3220,10 +3476,15 @@ def main():
     }))
     return
   if "--fleet" in args and "--dry-run" in args:
-    # Tier-1 smoke of the fleet path: a REAL (tiny) multi-process run
-    # — 2 actors + host + learner through the launch gate — NO
-    # detail-file write.
+    # Tier-1 smoke of the fleet path: REAL (tiny) multi-process runs
+    # — the single-host loopback leg, a tiny CROSS-HOST TCP leg
+    # (2 serving hosts + 2 replay shard hosts on real ports, every
+    # RPC through fleet/transport.py, qtopt_fleet_tcp.gin as the
+    # launch gate), and the tiny wire microbench — NO detail-file
+    # write.
     smoke = bench_fleet(dry_run=True)
+    tcp_leg = smoke["cross_host_tcp"]["actors_2"]
+    wire_row = smoke["wire_serialization"]["payloads"][0]
     print(json.dumps({
         "fleet_dry_run": "ok",
         "num_actors": smoke["num_actors"],
@@ -3232,6 +3493,14 @@ def main():
         "publishes": smoke["publishes"],
         "param_refresh_lag_rows": smoke["param_refresh_lag"]["rows"],
         "clean_shutdown": smoke["clean_shutdown"],
+        "cross_host_tcp_env_steps_per_sec":
+            tcp_leg["env_steps_per_sec"],
+        "cross_host_tcp_lag_hops": sorted(
+            (tcp_leg["param_refresh_lag"].get("by_hop") or {})),
+        "cross_host_tcp_clean_shutdown": tcp_leg["clean_shutdown"],
+        "wire_oob_speedup": wire_row["oob_speedup"],
+        "wire_oob_copies": [wire_row["oob_send_payload_copies"],
+                            wire_row["oob_recv_payload_copies"]],
     }))
     return
   if "--chaos" in args and "--dry-run" in args:

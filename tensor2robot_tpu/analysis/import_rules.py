@@ -35,6 +35,10 @@ WORKER_SAFE_MODULES = (
     "tensor2robot_tpu.config",
     "tensor2robot_tpu.config.ginlite",
     "tensor2robot_tpu.fleet.rpc",
+    # ISSUE 16: the socket transport under rpc — actors dial serving
+    # hosts and replay shards over it, so it lives in the jax-free
+    # closure with the rest of the RPC plane.
+    "tensor2robot_tpu.fleet.transport",
     "tensor2robot_tpu.fleet.proc",
     "tensor2robot_tpu.fleet.actor",
     # ISSUE 14: the fault-injection plan rides inside FleetConfig to

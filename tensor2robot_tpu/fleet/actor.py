@@ -27,10 +27,11 @@ with RPC-backed implementations:
 
 from __future__ import annotations
 
+import hashlib
 import logging
 import os
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -48,6 +49,44 @@ log = logging.getLogger(__name__)
 CRASH_EXIT_CODE = 13
 
 
+def home_shard(actor_id: str, num_shards: int) -> int:
+  """The actor's consistent-hash home replay shard (ISSUE 16).
+
+  Rendezvous (highest-random-weight) hashing: each (actor, shard)
+  pair gets a deterministic pseudo-random weight and the actor homes
+  on its max. The property that matters operationally: when the shard
+  set changes, ONLY the actors homed on a removed shard remap —
+  everyone else's episodes keep landing where they always did
+  (pinned by tests/test_fleet_transport.py).
+  """
+  if num_shards <= 0:
+    raise ValueError(f"num_shards must be positive, got {num_shards}")
+  best, best_weight = 0, -1
+  for shard in range(num_shards):
+    digest = hashlib.sha256(
+        f"{actor_id}|shard-{shard}".encode()).digest()
+    weight = int.from_bytes(digest[:8], "big")
+    if weight > best_weight:
+      best, best_weight = shard, weight
+  return best
+
+
+def address_book(address) -> Dict[str, List[Tuple[str, int]]]:
+  """Normalizes an RPC target into the fleet's address book.
+
+  A bare `(host, port)` tuple — every pre-sharding caller — means one
+  serving host that also owns the replay plane. The orchestrator's
+  multi-host launches pass `{"serving": [...], "shards": [...]}`
+  instead: serving[0] is the ROOT (reference clock, learner control),
+  and a non-empty `shards` list moves every commit/sample to the
+  shard services.
+  """
+  if isinstance(address, dict):
+    return {"serving": [tuple(a) for a in address.get("serving", ())],
+            "shards": [tuple(a) for a in address.get("shards", ())]}
+  return {"serving": [tuple(address)], "shards": []}
+
+
 class FleetPolicyClient:
   """`GraspActor.policy_server`-shaped proxy to the host's CEM server."""
 
@@ -56,6 +95,7 @@ class FleetPolicyClient:
     self.max_batch = int(max_batch)
     self.params_version = 0
     self.params_learner_step = 0
+    self.params_hop = 0
 
   @property
   def engine(self) -> "FleetPolicyClient":
@@ -70,6 +110,9 @@ class FleetPolicyClient:
         "act", {k: np.asarray(v) for k, v in observations.items()})
     self.params_version = int(reply["params_version"])
     self.params_learner_step = int(reply["params_learner_step"])
+    # The acting host's broadcast-tree depth: stamped into commits so
+    # the shard attributes param_refresh_lag PER HOP (ISSUE 16).
+    self.params_hop = int(reply.get("params_hop", 0))
     return np.asarray(reply["actions"])
 
   def update_state(self, state) -> None:
@@ -97,7 +140,8 @@ class FleetReplaySession:
     if self._policy is None:
       return {"policy_version": None, "policy_learner_step": None}
     return {"policy_version": self._policy.params_version,
-            "policy_learner_step": self._policy.params_learner_step}
+            "policy_learner_step": self._policy.params_learner_step,
+            "policy_hop": self._policy.params_hop}
 
   def add(self, transitions: Dict[str, Any]) -> bool:
     flat = {k: np.asarray(v) for k, v in transitions.items()}
@@ -188,22 +232,53 @@ def actor_main(config, actor_index: int, address, stop_event,
   # `install` also arms the RPC client-side seam for this process.
   injector = faults_lib.install(config, actor_id,
                                 incarnation=incarnation)
-  client = RpcClient(
-      tuple(address), authkey=config.authkey,
+  rpc_kwargs = dict(
+      authkey=config.authkey,
       call_timeout_secs=config.rpc_call_timeout_secs,
-      max_retries=config.rpc_max_retries)
+      max_retries=config.rpc_max_retries,
+      transport=getattr(config, "transport", "loopback"),
+      sndbuf=getattr(config, "tcp_sndbuf", 0),
+      rcvbuf=getattr(config, "tcp_rcvbuf", 0))
+  book = address_book(address)
+  serving = book["serving"]
+  # Multi-host placement (ISSUE 16): act against this actor's serving
+  # host (round-robin over the broadcast tree — deeper hosts see
+  # params later, which the per-hop lag attribution measures), commit
+  # to the rendezvous-hash home shard (or the same host when the
+  # replay plane is unsharded).
+  act_address = serving[actor_index % len(serving)]
+  client = RpcClient(act_address, **rpc_kwargs)
+  commit_client: Optional[RpcClient] = None
   try:
     t_before = time.monotonic()
     hello = client.call("hello")
     t_after = time.monotonic()
-    if "monotonic" in hello:
-      # The clock handshake: this actor's spans merge onto the host's
-      # monotonic timeline (telemetry.merge).
+    if "monotonic" in hello and act_address == serving[0]:
+      # The clock handshake: this actor's spans merge onto the ROOT
+      # host's monotonic timeline (telemetry.merge).
       telemetry.get_tracer().set_clock_offset(
           telemetry.clock_offset_from_handshake(
               hello["monotonic"], t_before, t_after))
+    if act_address != serving[0]:
+      # Acting against a replica: the reference clock is still the
+      # root's — one transient hello aligns this trace.
+      with RpcClient(serving[0], **rpc_kwargs) as root:
+        t_before = time.monotonic()
+        root_hello = root.call("hello")
+        t_after = time.monotonic()
+        if "monotonic" in root_hello:
+          telemetry.get_tracer().set_clock_offset(
+              telemetry.clock_offset_from_handshake(
+                  root_hello["monotonic"], t_before, t_after))
     policy = FleetPolicyClient(client, max_batch=hello["max_batch"])
-    sink = FleetReplaySession(client, actor_id, policy)
+    if book["shards"]:
+      shard = home_shard(actor_id, len(book["shards"]))
+      commit_client = RpcClient(book["shards"][shard], **rpc_kwargs)
+      sink = FleetReplaySession(commit_client, actor_id, policy)
+      log.info("%s commits to replay shard %d at %s", actor_id, shard,
+               book["shards"][shard])
+    else:
+      sink = FleetReplaySession(client, actor_id, policy)
     env = build_env(config, actor_index)
 
     from tensor2robot_tpu.research.qtopt.actor import GraspActor
@@ -281,4 +356,6 @@ def actor_main(config, actor_index: int, address, stop_event,
   finally:
     perf_lib.stop_resource_sampler()
     telemetry.get_tracer().close()
+    if commit_client is not None:
+      commit_client.close()
     client.close()

@@ -25,11 +25,12 @@ from __future__ import annotations
 
 import logging
 import time
-from typing import Any, Dict, Iterator, Optional
+from typing import Any, Dict, Iterator, List, Optional, Sequence
 
 from tensor2robot_tpu import telemetry
 from tensor2robot_tpu.fleet import faults as faults_lib
 from tensor2robot_tpu.fleet import proc
+from tensor2robot_tpu.fleet.actor import address_book
 from tensor2robot_tpu.fleet.rpc import RpcClient
 from tensor2robot_tpu.hooks.hook import Hook
 from tensor2robot_tpu.telemetry import flightrec
@@ -39,19 +40,36 @@ log = logging.getLogger(__name__)
 
 
 class RemoteReplay:
-  """`train_qtopt`-facing replay facade over the fleet host."""
+  """`train_qtopt`-facing replay facade over the fleet's replay plane.
+
+  Unsharded (the single-host default): every call rides the host's
+  control/stream clients, unchanged. Sharded (ISSUE 16,
+  `replay_hosts > 0`): a batch is assembled from per-shard `sample`
+  RPCs — counts proportional to shard fill, concatenated SHARD-MAJOR
+  (`replay.sampler.shard_fanout_counts` / `concat_shard_major`, the
+  PR-3 gather contract) — and `set_learner_step` tags every shard's
+  store so staleness/lag stay correct where each shard lives. Client
+  ownership follows the module contract: `*_controls` belong to the
+  train thread, `*_streams` to the prefetch thread.
+  """
 
   def __init__(self, control: RpcClient, stream: RpcClient,
-               capacity: int):
+               capacity: int,
+               shard_controls: Sequence[RpcClient] = (),
+               shard_streams: Sequence[RpcClient] = ()):
     self._control = control
     self._stream = stream
     self._capacity = int(capacity)
+    self._shard_controls = list(shard_controls)
+    self._shard_streams = list(shard_streams)
 
   @property
   def capacity(self) -> int:
     return self._capacity
 
   def __len__(self) -> int:
+    if self._shard_controls:
+      return sum(int(c.call("size")) for c in self._shard_controls)
     return int(self._control.call("size"))
 
   def wait_until_size(self, min_size: int,
@@ -68,23 +86,58 @@ class RemoteReplay:
     from tensor2robot_tpu.specs import TensorSpecStruct
     return TensorSpecStruct.from_flat_dict(flat)
 
+  def _fanout_sample(self, clients: List[RpcClient], batch_size: int):
+    """One shard-major batch via per-shard RPCs on `clients` (which
+    must belong to the calling thread — single-owner rule)."""
+    from tensor2robot_tpu.replay.sampler import (
+        concat_shard_major,
+        shard_fanout_counts,
+    )
+    sizes = tuple(int(c.call("size")) for c in clients)
+    counts = shard_fanout_counts(batch_size, sizes)
+    parts = [client.call("sample", count)
+             for client, count in zip(clients, counts) if count]
+    return self._to_struct(concat_shard_major(parts))
+
   def sample(self, batch_size: int):
     """Control-channel sample (int8 calibration runs pre-loop, on the
     train thread, before the prefetcher owns the stream channel)."""
+    if self._shard_controls:
+      return self._fanout_sample(self._shard_controls, int(batch_size))
     return self._to_struct(self._control.call("sample", int(batch_size)))
 
   def as_stream(self, batch_size: int) -> Iterator[Any]:
     def _gen():
       while True:
-        yield self._to_struct(
-            self._stream.call("sample", int(batch_size)))
+        if self._shard_streams:
+          yield self._fanout_sample(self._shard_streams,
+                                    int(batch_size))
+        else:
+          yield self._to_struct(
+              self._stream.call("sample", int(batch_size)))
     return _gen()
 
   def set_learner_step(self, step: int) -> None:
+    # The root host always gets the tag (its learner-window/resume
+    # witness), and on the sharded plane so does every shard — the
+    # staleness/lag clock must tick WHERE the rows live.
     self._control.call("set_learner_step", int(step))
+    for client in self._shard_controls:
+      client.call("set_learner_step", int(step))
 
   def metrics_scalars(self) -> Dict[str, float]:
-    return self._control.call("metrics_scalars")
+    out = dict(self._control.call("metrics_scalars"))
+    merged: Dict[str, float] = {}
+    for client in self._shard_controls:
+      for key, value in client.call("metrics_scalars").items():
+        if any(tag in key for tag in ("mean", "max", "p95")):
+          # Distributional scalars don't sum across shards; the
+          # pessimistic envelope (max) is the honest merge.
+          merged[key] = max(merged.get(key, 0.0), float(value))
+        else:
+          merged[key] = merged.get(key, 0.0) + float(value)
+    out.update(merged)
+    return out
 
 
 class ParamPublishHook(Hook):
@@ -104,9 +157,15 @@ class ParamPublishHook(Hook):
               if hasattr(state, "replace")
               and hasattr(state, "opt_state") else state)
     with telemetry.span("learner.publish_params", step=int(step)):
+      # `origin_wall`/`hop` seed the broadcast tree's per-hop
+      # accounting: every host that swaps this publication — root or
+      # forwarded — measures origin→swap against the shared wall
+      # clock and tags its depth (ISSUE 16).
       self._control.call("publish", {
           "step": int(step),
           "state": jax.device_get(acting),
+          "origin_wall": time.time(),
+          "hop": 0,
       })
     self.publishes += 1
     tmetrics.counter("learner.param_publishes").inc()
@@ -192,9 +251,19 @@ def learner_main(config, model_dir: str, address, heartbeat,
   rpc_kwargs = dict(
       authkey=config.authkey,
       call_timeout_secs=config.rpc_call_timeout_secs,
-      max_retries=config.rpc_max_retries)
-  control = RpcClient(tuple(address), **rpc_kwargs)
-  stream = RpcClient(tuple(address), **rpc_kwargs)
+      max_retries=config.rpc_max_retries,
+      transport=getattr(config, "transport", "loopback"),
+      sndbuf=getattr(config, "tcp_sndbuf", 0),
+      rcvbuf=getattr(config, "tcp_rcvbuf", 0))
+  book = address_book(address)
+  root = book["serving"][0]
+  control = RpcClient(root, **rpc_kwargs)
+  stream = RpcClient(root, **rpc_kwargs)
+  # Sharded replay plane: control clients for the train thread,
+  # stream clients for the prefetch thread — two per shard, same
+  # single-owner discipline as the root pair.
+  shard_controls = [RpcClient(a, **rpc_kwargs) for a in book["shards"]]
+  shard_streams = [RpcClient(a, **rpc_kwargs) for a in book["shards"]]
   try:
     from tensor2robot_tpu.parallel.distributed import (
         maybe_initialize_distributed,
@@ -211,7 +280,9 @@ def learner_main(config, model_dir: str, address, heartbeat,
       telemetry.get_tracer().set_clock_offset(
           telemetry.clock_offset_from_handshake(
               hello["monotonic"], t_before, t_after))
-    replay = RemoteReplay(control, stream, capacity=hello["capacity"])
+    replay = RemoteReplay(control, stream, capacity=hello["capacity"],
+                          shard_controls=shard_controls,
+                          shard_streams=shard_streams)
     hooks = [ParamPublishHook(
         control,
         telemetry_push=bool(getattr(config, "telemetry_dir", ""))),
@@ -246,5 +317,7 @@ def learner_main(config, model_dir: str, address, heartbeat,
     from tensor2robot_tpu.telemetry import perf as perf_lib
     perf_lib.stop_resource_sampler()
     telemetry.get_tracer().close()
+    for client in shard_streams + shard_controls:
+      client.close()
     stream.close()
     control.close()

@@ -57,7 +57,7 @@ from tensor2robot_tpu.fleet import actor as actor_lib
 from tensor2robot_tpu.fleet import faults as faults_lib
 from tensor2robot_tpu.fleet import host as host_lib
 from tensor2robot_tpu.fleet import learner as learner_lib
-from tensor2robot_tpu.fleet.rpc import RpcClient
+from tensor2robot_tpu.fleet.rpc import RpcClient, TRANSPORTS
 from tensor2robot_tpu.telemetry import core as tcore
 from tensor2robot_tpu.telemetry import flightrec
 from tensor2robot_tpu.telemetry import metrics as tmetrics
@@ -76,6 +76,29 @@ _OVERFLOW = ("drop", "block")
 
 class FleetError(RuntimeError):
   """A latched fleet failure (child death, hang, launch-gate reject)."""
+
+
+# ---- broadcast tree shape (ISSUE 16) ----
+#
+# Learner publications fan over a complete d-ary tree in HEAP LAYOUT
+# over the serving-host list: host 0 is the root (the learner's only
+# publish target) and host i forwards to serving[i*d+1 : i*d+1+d].
+# Pure functions so the mapping is unit-testable without processes.
+
+
+def broadcast_children(index: int, num_hosts: int,
+                       degree: int) -> List[int]:
+  """Serving-host indices `index` forwards publications to."""
+  first = index * degree + 1
+  return list(range(first, min(first + degree, num_hosts)))
+
+
+def broadcast_depths(num_hosts: int, degree: int) -> List[int]:
+  """Per-host hop count from the root (root = 0)."""
+  depths = [0] * num_hosts
+  for i in range(1, num_hosts):
+    depths[i] = depths[(i - 1) // degree] + 1
+  return depths
 
 
 @gin.configurable
@@ -115,6 +138,23 @@ class FleetConfig:
   # Serving plane.
   serve_max_batch: int = 8
   serve_max_wait_us: int = 200
+  # Cross-host topology (ISSUE 16). transport="tcp" moves every fleet
+  # RPC onto fleet/transport.py's length-prefixed socket framing with
+  # out-of-band buffer serialization (loopback stays the stdlib
+  # multiprocessing.connection default, bitwise-identical behavior).
+  # serving_hosts > 1 spawns engine-only serving replicas; actors
+  # spread act traffic round-robin and learner publications fan over a
+  # `broadcast_degree`-ary tree rooted at host 0. replay_hosts > 0
+  # moves the replay plane onto dedicated shard processes (one shard
+  # per host); actors commit to their rendezvous-hashed home shard and
+  # the learner's sampler fans across shards shard-major. Replicas own
+  # no replay store, so serving_hosts > 1 requires replay_hosts >= 1.
+  transport: str = "loopback"
+  tcp_sndbuf: int = 0  # 0 = kernel default (SO_SNDBUF untouched)
+  tcp_rcvbuf: int = 0
+  serving_hosts: int = 1
+  replay_hosts: int = 0
+  broadcast_degree: int = 2
   # Lifecycle. The restart budget is RATE-based (ISSUE 14): a crashed
   # actor may be respawned up to `max_actor_restarts` times per
   # `restart_window_secs` sliding window — a crash-loop trips the
@@ -197,6 +237,24 @@ class FleetConfig:
     if self.overflow not in _OVERFLOW:
       raise ValueError(
           f"overflow must be one of {_OVERFLOW}, got {self.overflow!r}")
+    if self.transport not in TRANSPORTS:
+      raise ValueError(
+          f"transport must be one of {TRANSPORTS}, got "
+          f"{self.transport!r}")
+    if self.serving_hosts < 1:
+      raise ValueError(
+          f"serving_hosts must be >= 1, got {self.serving_hosts}")
+    if self.replay_hosts < 0:
+      raise ValueError(
+          f"replay_hosts must be >= 0, got {self.replay_hosts}")
+    if self.broadcast_degree < 1:
+      raise ValueError(
+          f"broadcast_degree must be >= 1, got {self.broadcast_degree}")
+    if self.serving_hosts > 1 and self.replay_hosts < 1:
+      raise ValueError(
+          "serving_hosts > 1 requires replay_hosts >= 1: serving "
+          "replicas are engine-only (no replay store), so the replay "
+          "plane must live on dedicated shard hosts")
     if self.fault_plan is not None and not isinstance(
         self.fault_plan, faults_lib.FaultPlan):
       raise ValueError(
@@ -249,6 +307,17 @@ class Fleet:
     # setting every per-actor event under `_scale_lock`.
     self._host_stop = self._ctx.Event()
     self._host: Optional[mp.Process] = None
+    # Cross-host topology (ISSUE 16): serving replicas (host_index>0)
+    # and replay shard hosts, all sharing `_host_stop` — every
+    # host-class process must outlive the actor/learner drain so the
+    # shutdown barrier can read final metrics from each.
+    self._serving: Dict[int, mp.Process] = {}
+    self._shards: Dict[int, mp.Process] = {}
+    # One persistent control entry per extra host: {name, address,
+    # client} — client opened lazily, dropped on poisoning like the
+    # root control channel.
+    self._aux_hosts: List[Dict[str, Any]] = []
+    self._addresses: Optional[Dict[str, Any]] = None
     self._learner: Optional[mp.Process] = None
     self._actors: Dict[int, mp.Process] = {}
     self._actor_stops: Dict[int, Any] = {}
@@ -311,8 +380,8 @@ class Fleet:
       stop = self._actor_stops[index] = self._ctx.Event()
     process = self._ctx.Process(
         target=actor_lib.actor_main,
-        args=(self._run_config, index, self._address, stop,
-              heartbeat, incarnation),
+        args=(self._run_config, index, self._addresses or self._address,
+              stop, heartbeat, incarnation),
         name=name, daemon=True)
     process.start()
     self._actors[index] = process
@@ -326,14 +395,135 @@ class Fleet:
       coordinator_address = ephemeral_coordinator_address()
     self._learner = self._ctx.Process(
         target=learner_lib.learner_main,
-        args=(self._run_config, self.model_dir, self._address,
+        args=(self._run_config, self.model_dir,
+              self._addresses or self._address,
               self._heartbeat("t2r-fleet-learner"), coordinator_address,
               incarnation),
         name="t2r-fleet-learner", daemon=True)
     self._learner.start()
 
+  def _await_ready(self, parent_conn: Any, process: mp.Process,
+                   what: str, timeout_secs: float) -> Tuple[str, int]:
+    """One ready-handshake: blocks for the child's address report."""
+    if not parent_conn.poll(timeout_secs):
+      raise FleetError(
+          f"{what} did not report ready within {timeout_secs:.0f}s "
+          f"(exitcode={process.exitcode})")
+    try:
+      info = parent_conn.recv()
+    except (EOFError, OSError):
+      # poll() also returns True on EOF: a child that died DURING
+      # construction (bad config, import failure) lands here, not in
+      # the timeout branch — same latch/abort treatment.
+      process.join(timeout=10.0)
+      raise FleetError(
+          f"{what} died before reporting ready "
+          f"(exitcode={process.exitcode})") from None
+    parent_conn.close()
+    return tuple(info["address"])
+
+  def _spawn_extra_hosts(self, config: FleetConfig) -> None:
+    """Serving replicas + replay shard hosts: spawn all, then await
+    every ready-handshake under ONE shared launch deadline."""
+    pending: List[Tuple[Dict[str, Any], Any, mp.Process, str]] = []
+    for i in range(1, config.serving_hosts):
+      name = f"t2r-fleet-host-{i}"
+      parent_conn, child_conn = self._ctx.Pipe()
+      process = self._ctx.Process(
+          target=host_lib.host_main,
+          args=(config, child_conn, self._host_stop,
+                self._heartbeat(name), i, self._address),
+          name=name, daemon=True)
+      process.start()
+      child_conn.close()
+      self._serving[i] = process
+      entry = {"kind": "serving", "index": i, "name": f"host{i}",
+               "address": None, "client": None}
+      self._aux_hosts.append(entry)
+      pending.append((entry, parent_conn, process, f"serving host {i}"))
+    for i in range(config.replay_hosts):
+      name = f"t2r-fleet-shard-{i}"
+      parent_conn, child_conn = self._ctx.Pipe()
+      process = self._ctx.Process(
+          target=host_lib.replay_shard_main,
+          args=(config, i, self._address, child_conn, self._host_stop,
+                self._heartbeat(name)),
+          name=name, daemon=True)
+      process.start()
+      child_conn.close()
+      self._shards[i] = process
+      entry = {"kind": "shard", "index": i, "name": f"shard{i}",
+               "address": None, "client": None}
+      self._aux_hosts.append(entry)
+      pending.append((entry, parent_conn, process, f"replay shard {i}"))
+    deadline = time.monotonic() + config.launch_timeout_secs
+    for entry, parent_conn, process, what in pending:
+      remaining = max(0.0, deadline - time.monotonic())
+      entry["address"] = self._await_ready(
+          parent_conn, process, what, remaining)
+
+  def _aux_client(self, entry: Dict[str, Any]) -> Optional[RpcClient]:
+    """The entry's control client, (re)connected on demand. Same
+    single-shot envelope as the root control channel."""
+    if entry["client"] is None:
+      config = self._run_config
+      try:
+        entry["client"] = RpcClient(
+            entry["address"], authkey=config.authkey,
+            connect_timeout_secs=10.0,
+            call_timeout_secs=config.rpc_call_timeout_secs,
+            max_retries=0, transport=config.transport,
+            sndbuf=config.tcp_sndbuf, rcvbuf=config.tcp_rcvbuf)
+      except Exception:  # noqa: BLE001
+        log.warning("control reconnect to %s failed", entry["name"],
+                    exc_info=True)
+        return None
+    return entry["client"]
+
+  def _aux_call(self, entry: Dict[str, Any], method: str,
+                payload: Any = None,
+                timeout_secs: Optional[float] = None) -> Any:
+    """One control call to an extra host; poisoned-on-timeout clients
+    are dropped so the next call reconnects (rpc.py contract)."""
+    client = self._aux_client(entry)
+    if client is None:
+      raise FleetError(f"no control channel to {entry['name']}")
+    try:
+      return client.call(method, payload, timeout_secs=timeout_secs)
+    except Exception:
+      client.close()
+      entry["client"] = None
+      raise
+
+  def _configure_broadcast(self, config: FleetConfig) -> None:
+    """Wires the d-ary publication tree over the serving hosts: each
+    host learns its forward set and its depth (stamped into act
+    replies as `params_hop` for per-hop lag attribution)."""
+    serving = self._addresses["serving"]
+    if len(serving) < 2:
+      return  # single serving host: root defaults (no children, hop 0)
+    depths = broadcast_depths(len(serving), config.broadcast_degree)
+    replicas = [entry for entry in self._aux_hosts
+                if entry["kind"] == "serving"]
+    for i in range(len(serving)):
+      children = [list(serving[c]) for c in broadcast_children(
+          i, len(serving), config.broadcast_degree)]
+      payload = {"children": children, "depth": depths[i]}
+      if i == 0:
+        self._control.call("configure_broadcast", payload,
+                           timeout_secs=30.0)
+      else:
+        self._aux_call(replicas[i - 1], "configure_broadcast", payload,
+                       timeout_secs=30.0)
+    if self._tracer is not None:
+      self._tracer.event("fleet.broadcast_configured",
+                         hosts=len(serving),
+                         degree=config.broadcast_degree,
+                         max_depth=max(depths))
+
   def launch(self) -> None:
-    """Gate → host (handshake) → actors → learner."""
+    """Gate → hosts (handshakes) → broadcast wiring → actors →
+    learner."""
     if self._launched:
       return
     self._run_launch_gate()
@@ -378,30 +568,28 @@ class Fleet:
         name="t2r-fleet-host", daemon=True)
     self._host.start()
     child_conn.close()
-    # Handshake: the host reports its bound RPC address once its
-    # engine is warm; a host that died compiling surfaces here with
-    # its exit code instead of a silent hang.
-    if not parent_conn.poll(config.launch_timeout_secs):
-      self._latch(FleetError(
-          f"host did not report ready within "
-          f"{config.launch_timeout_secs:.0f}s "
-          f"(exitcode={self._host.exitcode})"))
-      self._abort()
-      raise self._error
     try:
-      info = parent_conn.recv()
-    except (EOFError, OSError):
-      # poll() also returns True on EOF: a host that died DURING
-      # construction (bad config, import failure) lands here, not in
-      # the timeout branch — same latch/abort treatment.
-      self._host.join(timeout=10.0)
-      self._latch(FleetError(
-          "host died before reporting ready "
-          f"(exitcode={self._host.exitcode})"))
+      # Handshake: the host reports its bound RPC address once its
+      # engine is warm; a host that died compiling surfaces here with
+      # its exit code instead of a silent hang.
+      self._address = self._await_ready(
+          parent_conn, self._host, "host", config.launch_timeout_secs)
+      # Extra hosts (ISSUE 16): serving replicas + replay shards, all
+      # handshaking against the ROOT's clock. Spawned after the root
+      # is warm (they need its address), awaited in parallel — the
+      # launch timeout covers the whole topology, not each host.
+      self._spawn_extra_hosts(config)
+    except FleetError as e:
+      self._latch(e)
       self._abort()
       raise self._error from None
-    parent_conn.close()
-    self._address = tuple(info["address"])
+    self._addresses = {
+        "serving": [self._address] + [
+            entry["address"] for entry in self._aux_hosts
+            if entry["kind"] == "serving"],
+        "shards": [entry["address"] for entry in self._aux_hosts
+                   if entry["kind"] == "shard"],
+    }
     # The control channel rides the DEADLINE half of the envelope
     # only: every control call sits on a latency-bounded path (the
     # supervision loop, the shutdown barrier, forensics) with its own
@@ -412,7 +600,15 @@ class Fleet:
     self._control = RpcClient(
         self._address, authkey=config.authkey,
         call_timeout_secs=config.rpc_call_timeout_secs,
-        max_retries=0)
+        max_retries=0, transport=config.transport,
+        sndbuf=config.tcp_sndbuf, rcvbuf=config.tcp_rcvbuf)
+    try:
+      self._configure_broadcast(config)
+    except Exception as e:  # noqa: BLE001 — any wiring failure is fatal
+      self._latch(FleetError(f"broadcast-tree configuration failed: "
+                             f"{e!r}"))
+      self._abort()
+      raise self._error from None
     for index in range(config.num_actors):
       self._restarts[index] = 0
       self._spawn_actor(index, incarnation=0)
@@ -623,6 +819,21 @@ class Fleet:
     for role, pushed in (view.get("pushed") or {}).items():
       payload.update(tmetrics.scalars_from_snapshot(
           pushed.get("snapshot") or {}, prefix=f"{role}/"))
+    # Extra hosts fold into the SAME envelope, namespaced per host
+    # (host1/..., shard0/...); pushed snapshots keep their role keys
+    # (actor ids are fleet-unique, whichever host they report to).
+    for entry in self._aux_hosts:
+      try:
+        aux_view = self._aux_call(entry, "telemetry", timeout_secs=30.0)
+      except Exception:  # noqa: BLE001 — instrumentation only
+        log.warning("telemetry poll of %s failed", entry["name"],
+                    exc_info=True)
+        continue
+      payload.update(tmetrics.scalars_from_snapshot(
+          aux_view.get("host") or {}, prefix=f"{entry['name']}/"))
+      for role, pushed in (aux_view.get("pushed") or {}).items():
+        payload.update(tmetrics.scalars_from_snapshot(
+            pushed.get("snapshot") or {}, prefix=f"{role}/"))
     record = trecords.make_record(
         int(payload.get("replay.learner_step", 0)), payload,
         role="orchestrator")
@@ -755,6 +966,18 @@ class Fleet:
       if self._host.exitcode is not None:
         raise FleetError(
             f"replay/serving host died (exit {self._host.exitcode})")
+      # Every host-class process is load-bearing topology: a dead
+      # serving replica strands its actors' act traffic and its
+      # broadcast subtree; a dead shard strands committed experience.
+      # Both stay fatal (actors are the only elastic tier).
+      for index, process in self._serving.items():
+        if process.exitcode is not None:
+          raise FleetError(
+              f"serving host {index} died (exit {process.exitcode})")
+      for index, process in self._shards.items():
+        if process.exitcode is not None:
+          raise FleetError(
+              f"replay shard {index} died (exit {process.exitcode})")
       for index, process in list(self._actors.items()):
         if process.exitcode is None:
           continue
@@ -859,6 +1082,8 @@ class Fleet:
       procs.append(self._learner)
     if self._host is not None:
       procs.append(self._host)
+    procs.extend(self._serving.values())
+    procs.extend(self._shards.values())
     return [p for p in procs if p is not None]
 
   def shutdown(self, timeout_secs: float = 60.0,
@@ -914,6 +1139,28 @@ class Fleet:
             log.warning("final telemetry read failed", exc_info=True)
             self._control.close()
             self._control = self._fresh_control()
+    if metrics is not None and self._aux_hosts:
+      # Cross-host final view: every extra host reports before the
+      # stop event lands, and the per-host reads merge into ONE
+      # `_result_from_metrics`-shaped dict (service counters summed
+      # across shards, commit window spanning min-first→max-last,
+      # lag histograms merged with weighted means) so the result
+      # math is topology-blind.
+      replica_metrics: List[Dict[str, Any]] = []
+      shard_metrics: List[Dict[str, Any]] = []
+      for entry in self._aux_hosts:
+        try:
+          aux = self._aux_call(entry, "metrics", timeout_secs=30.0)
+        except Exception:  # noqa: BLE001
+          log.warning("final metrics read from %s failed",
+                      entry["name"], exc_info=True)
+          continue
+        if entry["kind"] == "serving":
+          replica_metrics.append(aux)
+        else:
+          shard_metrics.append(aux)
+      metrics = _merge_fleet_metrics(
+          metrics, replica_metrics, shard_metrics)
     self._host_stop.set()
     if self._control is not None:
       if self._host is not None and self._host.is_alive():
@@ -928,6 +1175,16 @@ class Fleet:
       self._join_or_kill(self._learner, timeout_secs / 2, "learner")
     if self._host is not None:
       self._join_or_kill(self._host, timeout_secs / 2, "host")
+    for index, process in self._serving.items():
+      self._join_or_kill(process, timeout_secs / 2,
+                         f"serving host {index}")
+    for index, process in self._shards.items():
+      self._join_or_kill(process, timeout_secs / 2,
+                         f"replay shard {index}")
+    for entry in self._aux_hosts:
+      if entry["client"] is not None:
+        entry["client"].close()
+        entry["client"] = None
     if self._telemetry_file is not None:
       self._telemetry_file.close()
       self._telemetry_file = None
@@ -966,9 +1223,95 @@ class Fleet:
     return result
 
 
+def _merge_lag_snapshots(
+    snaps: Sequence[Optional[Dict[str, Any]]]) -> Optional[Dict[str, Any]]:
+  """Row-weighted merge of `LagStats.snapshot()` dicts across hosts."""
+  snaps = [s for s in snaps if s]
+  if not snaps:
+    return None
+  rows = sum(int(s.get("rows", 0)) for s in snaps)
+  histogram: Dict[str, int] = {}
+  for s in snaps:
+    for label, count in (s.get("histogram") or {}).items():
+      histogram[label] = histogram.get(label, 0) + int(count)
+  by_hop: Dict[str, List[float]] = {}
+  for s in snaps:
+    for hop, h in (s.get("by_hop") or {}).items():
+      acc = by_hop.setdefault(str(hop), [0, 0.0, 0])
+      n = int(h.get("rows", 0))
+      acc[0] += n
+      acc[1] += float(h.get("mean", 0.0)) * n
+      acc[2] = max(acc[2], int(h.get("max", 0)))
+  out: Dict[str, Any] = {
+      "rows": rows,
+      "mean": (sum(float(s.get("mean", 0.0)) * int(s.get("rows", 0))
+                   for s in snaps) / rows) if rows else 0.0,
+      "max": max(int(s.get("max", 0)) for s in snaps),
+      "histogram": histogram,
+  }
+  if by_hop:
+    out["by_hop"] = {
+        hop: {"rows": n, "mean": (total / n) if n else 0.0, "max": m}
+        for hop, (n, total, m) in sorted(
+            by_hop.items(), key=lambda kv: int(kv[0]))}
+  return out
+
+
+def _merge_fleet_metrics(
+    root: Dict[str, Any],
+    replicas: Sequence[Dict[str, Any]],
+    shards: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+  """One `_result_from_metrics`-shaped dict for a multi-host fleet.
+
+  Shard replay planes merge into the top-level replay keys — service
+  counters summed, the commit window spanning the earliest first to
+  the latest last (time.monotonic is one system-wide clock, so stamps
+  compare across processes on one machine), lag histograms merged
+  row-weighted, staleness namespaced per shard. Control-plane keys
+  (learner_window, publishes, params_version) stay the root's: the
+  root is the learner's control host and the broadcast origin. The
+  raw per-host dicts ride along for forensics.
+  """
+  merged = dict(root)
+  if shards:
+    store_sum: Dict[str, float] = {}
+    service_sum: Dict[str, float] = {}
+    staleness: Dict[str, Any] = {}
+    windows = []
+    for i, shard in enumerate(shards):
+      index = shard.get("shard_index", i)
+      for key, value in (shard.get("store") or {}).items():
+        if key == "learner_step":
+          store_sum[key] = max(store_sum.get(key, 0.0), float(value))
+        elif key != "fill":
+          store_sum[key] = store_sum.get(key, 0.0) + float(value)
+      for key, value in (shard.get("service") or {}).items():
+        service_sum[key] = service_sum.get(key, 0.0) + float(value)
+      for batch_size, snap in (shard.get("staleness") or {}).items():
+        staleness[f"shard{index}:{batch_size}"] = snap
+      if shard.get("commit_window"):
+        windows.append(shard["commit_window"])
+    if store_sum.get("capacity"):
+      store_sum["fill"] = store_sum.get("size", 0.0) / store_sum[
+          "capacity"]
+    merged["store"] = store_sum or None
+    merged["service"] = service_sum or None
+    merged["staleness"] = staleness
+    merged["param_refresh_lag"] = _merge_lag_snapshots(
+        [shard.get("param_refresh_lag") for shard in shards])
+    merged["commit_window"] = (None if not windows else {
+        "first_time": min(float(w["first_time"]) for w in windows),
+        "last_time": max(float(w["last_time"]) for w in windows),
+    })
+    merged["replay_shards"] = list(shards)
+  if replicas:
+    merged["serving_replicas"] = list(replicas)
+  return merged
+
+
 def _result_from_metrics(metrics: Dict[str, Any], wall_secs: float,
                          actor_restarts: int) -> FleetResult:
-  service = metrics.get("service", {})
+  service = metrics.get("service") or {}
   committed = float(service.get("replay_committed_transitions", 0.0))
   commit_window = metrics.get("commit_window") or {}
   commit_span = max(
@@ -982,8 +1325,8 @@ def _result_from_metrics(metrics: Dict[str, Any], wall_secs: float,
   return FleetResult(
       env_steps_per_sec=committed / commit_span,
       learner_steps_per_sec=step_span / time_span,
-      param_refresh_lag=metrics.get("param_refresh_lag", {}),
-      replay_staleness=metrics.get("staleness", {}),
+      param_refresh_lag=metrics.get("param_refresh_lag") or {},
+      replay_staleness=metrics.get("staleness") or {},
       publishes=int(metrics.get("publishes", 0)),
       params_version=int(metrics.get("params_version", 0)),
       actor_restarts=actor_restarts,

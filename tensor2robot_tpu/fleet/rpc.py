@@ -60,9 +60,22 @@ from multiprocessing.connection import Client, Listener
 from typing import Any, Callable, List, Optional, Tuple
 
 from tensor2robot_tpu import telemetry
+from tensor2robot_tpu.fleet import transport as transport_lib
 from tensor2robot_tpu.telemetry import metrics as tmetrics
 
 log = logging.getLogger(__name__)
+
+# Transport seam (ISSUE 16). "loopback" is the stdlib
+# multiprocessing.connection pair this module was born on — bitwise
+# back-compat, still the single-host default. "tcp" is
+# `fleet.transport`: real sockets, zero-copy out-of-band frames, the
+# same authkey riding an HMAC challenge — the cross-host path. The
+# deadline/retry/poisoning contract, the fault seams, and the span
+# stamps below are all WRITTEN AGAINST the shared connection shape
+# (send/recv/poll/close), so both transports inherit them from the
+# same lines of code rather than from parallel implementations
+# (tests/test_fleet_transport.py pins the parity).
+TRANSPORTS = ("loopback", "tcp")
 
 # The shared secret for connection auth. Loopback-only transport; the
 # orchestrator generates a per-fleet key so two fleets on one machine
@@ -109,13 +122,26 @@ class RpcServer:
   def __init__(self,
                handler: Callable[[str, Any, dict], Any],
                host: str = "127.0.0.1",
-               authkey: bytes = DEFAULT_AUTHKEY):
+               authkey: bytes = DEFAULT_AUTHKEY,
+               transport: str = "loopback",
+               sndbuf: int = 0,
+               rcvbuf: int = 0):
     """`handler(method, payload, ctx) -> result` runs on a
     per-connection thread; exceptions it raises are serialized back to
     the caller as `RpcError` (the connection stays up). On EOF the
-    synthetic `(DISCONNECT_METHOD, None, ctx)` call runs once."""
+    synthetic `(DISCONNECT_METHOD, None, ctx)` call runs once.
+    `transport`/`sndbuf`/`rcvbuf`: see `TRANSPORTS` above (buffer
+    sizes apply to "tcp" only; 0 = OS default)."""
+    if transport not in TRANSPORTS:
+      raise ValueError(
+          f"transport must be one of {TRANSPORTS}, got {transport!r}")
     self._handler = handler
-    self._listener = Listener((host, 0), authkey=authkey)
+    if transport == "tcp":
+      self._listener = transport_lib.TcpListener(
+          host, 0, authkey=authkey, sndbuf=sndbuf, rcvbuf=rcvbuf)
+    else:
+      self._listener = Listener((host, 0), authkey=authkey)
+    self.transport = transport
     self.address: Tuple[str, int] = self._listener.address
     self._stop = threading.Event()
     self._lock = threading.Lock()
@@ -238,15 +264,25 @@ class RpcClient:
                connect_timeout_secs: float = 20.0,
                call_timeout_secs: Optional[float] =
                DEFAULT_CALL_TIMEOUT_SECS,
-               max_retries: int = DEFAULT_MAX_RETRIES):
+               max_retries: int = DEFAULT_MAX_RETRIES,
+               transport: str = "loopback",
+               sndbuf: int = 0,
+               rcvbuf: int = 0):
     """`call_timeout_secs` is the default per-call reply deadline
     (None disables — the pre-ISSUE-14 strand-forever behavior, opt-in
     only); `max_retries` bounds reconnect-and-retry attempts per
     call. A retried caller needs no session re-establishment: the
     host rebuilds sessions server-side on first use of the fresh
-    connection (see the module docstring)."""
+    connection (see the module docstring). `transport` must match the
+    server's (see `TRANSPORTS`)."""
+    if transport not in TRANSPORTS:
+      raise ValueError(
+          f"transport must be one of {TRANSPORTS}, got {transport!r}")
     self._address = tuple(address)
     self._authkey = authkey
+    self._transport = transport
+    self._sndbuf = sndbuf
+    self._rcvbuf = rcvbuf
     self._connect_timeout = connect_timeout_secs
     self._call_timeout = call_timeout_secs
     self._max_retries = int(max_retries)
@@ -265,7 +301,12 @@ class RpcClient:
     last_error: Optional[BaseException] = None
     while True:
       try:
-        self._conn = Client(self._address, authkey=self._authkey)
+        if self._transport == "tcp":
+          self._conn = transport_lib.connect_tcp(
+              self._address, self._authkey,
+              sndbuf=self._sndbuf, rcvbuf=self._rcvbuf)
+        else:
+          self._conn = Client(self._address, authkey=self._authkey)
         return
       except (ConnectionRefusedError, FileNotFoundError, OSError) as e:
         # The host process may still be warming up its engine (or

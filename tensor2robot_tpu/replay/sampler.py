@@ -169,3 +169,52 @@ def make_stream(store: ReplayStore, batch_size: int,
   sampler = ReplayBatchSampler(store, batch_size,
                                record_schedule=record_schedule)
   return iter(sampler), sampler
+
+
+# ---- cross-shard fan-out (ISSUE 16: the sharded replay plane) ----
+#
+# With one shard per replay HOST, a learner batch is assembled from
+# per-shard sample RPCs instead of one store gather. These two pure
+# helpers define that assembly; `fleet.learner.RemoteReplay` applies
+# them over its shard clients. The result obeys the PR-3 gather
+# contract — rows grouped by shard, shards in index order (SHARD-MAJOR)
+# — so a cross-host batch has the same layout an in-process
+# multi-shard `sample_with_ages` gather produces.
+
+
+def shard_fanout_counts(batch_size: int,
+                        shard_sizes: Tuple[int, ...]) -> Tuple[int, ...]:
+  """Per-shard sample counts, proportional to shard fill.
+
+  Mirrors the in-store multi-shard draw (uniform over the TOTAL
+  population → expected counts proportional to shard sizes) with a
+  deterministic largest-remainder rounding: quotas floor, and the
+  leftover rows go to the largest fractional remainders (ties to the
+  lower shard index). Empty shards draw zero — a fleet whose actors
+  all hash to one shard still samples correctly.
+  """
+  sizes = [max(0, int(s)) for s in shard_sizes]
+  total = sum(sizes)
+  if batch_size < 0:
+    raise ValueError(f"batch_size must be >= 0, got {batch_size}")
+  if total == 0:
+    raise ValueError("cannot allocate a sample batch: every shard "
+                     "is empty")
+  quotas = [batch_size * s / total for s in sizes]
+  counts = [int(q) for q in quotas]
+  remainders = sorted(
+      range(len(sizes)), key=lambda i: (counts[i] - quotas[i], i))
+  for i in remainders[:batch_size - sum(counts)]:
+    counts[i] += 1
+  return tuple(counts)
+
+
+def concat_shard_major(
+    parts: "list[Dict[str, np.ndarray]]") -> Dict[str, np.ndarray]:
+  """Concatenates per-shard flat sample dicts in shard-index order."""
+  if not parts:
+    raise ValueError("no shard produced rows for this batch")
+  if len(parts) == 1:
+    return dict(parts[0])
+  return {key: np.concatenate([part[key] for part in parts], axis=0)
+          for key in parts[0]}

@@ -37,6 +37,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from tensor2robot_tpu import config as gin
+from tensor2robot_tpu.replay.sampler import ReplayBatchSampler
 from tensor2robot_tpu.replay.store import (
     ReplayStore,
     _record_event,
@@ -45,6 +46,12 @@ from tensor2robot_tpu.replay.store import (
 from tensor2robot_tpu.telemetry import metrics as tmetrics
 
 log = logging.getLogger(__name__)
+
+# Lag histogram bucket upper bounds, in learner steps (same labelling
+# scheme as the staleness histogram). ONE source of truth with the
+# telemetry registry's step-bucket family so the authoritative
+# snapshot and its registry twin can never desynchronize.
+LAG_BUCKETS = tuple(int(b) for b in tmetrics.DEFAULT_STEP_BOUNDS)
 
 OVERFLOW_POLICIES = ("drop", "block")
 
@@ -320,3 +327,225 @@ class ReplayWriteService:
           f"{prefix}aborted_episodes": float(self.aborted_episodes),
           f"{prefix}actor_restarts": float(self.restarts),
       }
+
+
+class LagStats:
+  """Thread-safe accumulator for the param-refresh-lag distribution.
+
+  Lives with the replay plane (not the serving host) because the lag
+  is MEASURED at commit time, wherever the committed rows land — on
+  the single-host fleet that is the host process, on the sharded
+  plane it is each shard service (ISSUE 16). `hop` attributes the lag
+  to the broadcast-tree depth of the serving host whose params the
+  actor acted with: per-hop sub-histograms quantify what each extra
+  tree hop costs in publication freshness.
+  """
+
+  def __init__(self):
+    self._lock = threading.Lock()
+    self._counts = np.zeros(len(LAG_BUCKETS) + 1, np.int64)
+    self._sum = 0
+    self._max = 0
+    self._n = 0
+    self._by_hop: Dict[int, List[int]] = {}  # hop -> [rows, sum, max]
+    self._tm_lag = tmetrics.histogram(
+        "fleet.param_refresh_lag_steps", tmetrics.DEFAULT_STEP_BOUNDS)
+
+  def record(self, lag: int, rows: int,
+             hop: Optional[int] = None) -> None:
+    lag = max(int(lag), 0)
+    bucket = int(np.searchsorted(LAG_BUCKETS, lag, side="left"))
+    with self._lock:
+      self._counts[bucket] += rows
+      self._sum += lag * rows
+      self._max = max(self._max, lag)
+      self._n += rows
+      if hop is not None:
+        acc = self._by_hop.setdefault(int(hop), [0, 0, 0])
+        acc[0] += rows
+        acc[1] += lag * rows
+        acc[2] = max(acc[2], lag)
+    # Twin publication into the process registry (same step-bucket
+    # family, same ROW weighting as the accumulator above), so the
+    # telemetry RPC serves lag without touching this class and the
+    # flight recorder captures it. The per-hop twin rides the same
+    # family under a `.hop<k>` suffix (catalogued as a placeholder
+    # row in docs/OBSERVABILITY.md).
+    self._tm_lag.observe(lag, n=rows)
+    if hop is not None:
+      tmetrics.histogram(f"fleet.param_refresh_lag_steps.hop{int(hop)}",
+                         tmetrics.DEFAULT_STEP_BOUNDS).observe(
+                             lag, n=rows)
+
+  def snapshot(self) -> Dict[str, Any]:
+    with self._lock:
+      labels = [f"<={b}" for b in LAG_BUCKETS] + [f">{LAG_BUCKETS[-1]}"]
+      out: Dict[str, Any] = {
+          "rows": int(self._n),
+          "mean": (self._sum / self._n) if self._n else 0.0,
+          "max": int(self._max),
+          "histogram": {label: int(count)
+                        for label, count in zip(labels, self._counts)},
+      }
+      if self._by_hop:
+        out["by_hop"] = {
+            str(hop): {"rows": int(n), "mean": (s / n) if n else 0.0,
+                       "max": int(m)}
+            for hop, (n, s, m) in sorted(self._by_hop.items())}
+      return out
+
+
+class ReplayFront:
+  """The replay plane's RPC-facing surface over ONE store.
+
+  Factored out of the fleet host (ISSUE 16) so the exact same
+  session/commit/sample/lag semantics serve two deployments:
+
+    * the single-host fleet — the serving host owns a `ReplayFront`
+      next to its engine (replay_hosts=0, unchanged behavior);
+    * the sharded plane — each `replay_shard_main` process owns a
+      1-shard store behind its own `ReplayFront`, actors commit to
+      their rendezvous-hash home shard, and the learner fans samples
+      across shards (`fleet.learner.RemoteReplay`), concatenating
+      shard-major per the PR-3 gather contract. Staleness and
+      param-refresh lag are accounted WHERE EACH SHARD LIVES — the
+      same choke-point principle, one process per shard.
+
+  The crash contract is inherited wholesale: sessions are tracked per
+  RPC connection (`ctx`) by OBJECT identity and aborted on
+  disconnect, so partial episodes never land no matter which process
+  the store is in.
+  """
+
+  def __init__(self, store: ReplayStore, service: "ReplayWriteService"):
+    self.store = store
+    self.service = service
+    self._samplers: Dict[int, ReplayBatchSampler] = {}
+    self._sessions: Dict[str, ActorIngestSession] = {}
+    self._lock = threading.Lock()
+    self.lag = LagStats()
+    self._commit_window: Optional[tuple] = None
+
+  # ---- sessions (the host's restart-with-abort contract) ----
+
+  def session_for(self, actor_id: str, ctx: dict) -> ActorIngestSession:
+    with self._lock:
+      session = self._sessions.get(actor_id)
+    if session is None or session.closed:
+      # A fresh claim under an existing actor_id is the restart path:
+      # `service.session` counts it and aborts whatever the dead
+      # incarnation staged (restart-with-session-abort).
+      session = self.service.session(actor_id)
+      with self._lock:
+        self._sessions[actor_id] = session
+    # Track the OBJECT this connection used, not just the id: a
+    # hard-killed actor's connection can be detected dead AFTER its
+    # replacement re-registered, and the late disconnect must abort
+    # the old incarnation's session, never the new one's.
+    ctx.setdefault("sessions", {})[actor_id] = session
+    return session
+
+  def abort_sessions(self, ctx: dict) -> None:
+    """The disconnect path: aborts every session this ctx opened."""
+    for actor_id, session in ctx.get("sessions", {}).items():
+      if not session.closed:
+        session.abort()
+      with self._lock:
+        if self._sessions.get(actor_id) is session:
+          del self._sessions[actor_id]
+
+  # ---- commits ----
+
+  def _record_commit(self, rows: int, policy_learner_step,
+                     hop: Optional[int]) -> None:
+    now = time.monotonic()
+    with self._lock:
+      first = self._commit_window[0] if self._commit_window else now
+      self._commit_window = (first, now)
+    if policy_learner_step is not None:
+      self.lag.record(
+          self.store.learner_step - int(policy_learner_step), rows,
+          hop=hop)
+
+  def commit(self, payload: Dict[str, Any], ctx: dict) -> bool:
+    session = self.session_for(payload["actor_id"], ctx)
+    accepted = session.add(payload["transitions"])
+    if accepted:
+      rows = int(next(iter(payload["transitions"].values())).shape[0])
+      self._record_commit(rows, payload.get("policy_learner_step"),
+                          payload.get("policy_hop"))
+    return bool(accepted)
+
+  def begin_episode(self, actor_id: str, ctx: dict) -> bool:
+    self.session_for(actor_id, ctx).begin_episode()
+    return True
+
+  def append(self, payload: Dict[str, Any], ctx: dict) -> bool:
+    self.session_for(payload["actor_id"], ctx).append(
+        payload["transitions"])
+    return True
+
+  def end_episode(self, payload: Dict[str, Any], ctx: dict) -> bool:
+    session = self.session_for(payload["actor_id"], ctx)
+    committed_before = session.transitions_committed
+    accepted = session.end_episode()
+    if accepted:
+      self._record_commit(
+          session.transitions_committed - committed_before,
+          payload.get("policy_learner_step"),
+          payload.get("policy_hop"))
+    return bool(accepted)
+
+  # ---- sampling / learner tag ----
+
+  def sampler(self, batch_size: int) -> ReplayBatchSampler:
+    with self._lock:
+      sampler = self._samplers.get(batch_size)
+      if sampler is None:
+        sampler = ReplayBatchSampler(self.store, batch_size)
+        self._samplers[batch_size] = sampler
+    return sampler
+
+  def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
+    batch = self.sampler(int(batch_size)).sample()
+    return {k: np.asarray(v) for k, v in batch.to_flat_dict().items()}
+
+  def size(self) -> int:
+    return len(self.store)
+
+  def set_learner_step(self, step: int) -> None:
+    self.store.set_learner_step(int(step))
+
+  # ---- reporting ----
+
+  def staleness(self) -> Dict[str, Any]:
+    with self._lock:
+      samplers = list(self._samplers.items())
+    return {str(batch_size): sampler.staleness_snapshot()
+            for batch_size, sampler in samplers}
+
+  def metrics(self) -> Dict[str, Any]:
+    with self._lock:
+      commit_window = self._commit_window
+    return {
+        "store": self.store.metrics_snapshot(),
+        "service": self.service.metrics_scalars(),
+        "staleness": self.staleness(),
+        "param_refresh_lag": self.lag.snapshot(),
+        "commit_window": (None if commit_window is None else {
+            "first_time": commit_window[0],
+            "last_time": commit_window[1],
+        }),
+    }
+
+  def metrics_scalars(self) -> Dict[str, float]:
+    out = self.store.metrics_scalars()
+    with self._lock:
+      samplers = list(self._samplers.values())
+    for sampler in samplers:
+      out.update(sampler.metrics_scalars())
+    out["fleet_param_refresh_lag_mean"] = self.lag.snapshot()["mean"]
+    return out
+
+  def close(self) -> None:
+    self.service.close()

@@ -21,6 +21,7 @@ from tensor2robot_tpu.parallel.distributed import (
 )
 
 
+@pytest.mark.slow
 def test_two_process_cluster_runs_sharded_train_step(tmp_path):
   repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
   worker = os.path.join(repo, "tests", "distributed_worker.py")

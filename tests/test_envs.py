@@ -888,6 +888,7 @@ class TestShardMapPodProgram:
     assert out.returncode == 0, out.stderr[-4000:]
     assert "BITWISE_OK" in out.stdout
 
+  @pytest.mark.slow
   def test_zero_rewrap_across_device_counts_does_not_stack(
       self, tmp_path):
     """Bench rows reuse ONE learner across device counts: the keyed

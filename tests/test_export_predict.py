@@ -105,6 +105,7 @@ class TestRawWireServing:
   the same graph parser serves serialized protos with near-memcpy
   decode (no image codec robot-side)."""
 
+  @pytest.mark.slow
   def test_raw_spec_proto_signature_round_trip(self, tmp_path):
     import tensorflow as tf
 

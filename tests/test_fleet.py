@@ -305,6 +305,7 @@ class TestHostSessionAbort:
 class TestFleetLifecycle:
   """Whole-topology runs: the expensive, load-bearing pins."""
 
+  @pytest.mark.slow
   def test_two_actor_smoke_end_to_end(self, tmp_path):
     shm_before = _shm_entries()
     # distributed_learner=True also exercises the collision-safe
@@ -337,6 +338,7 @@ class TestFleetLifecycle:
     del fleet
     _assert_no_new_shm(shm_before)
 
+  @pytest.mark.slow
   def test_actor_crash_restart_lands_no_partial_rows(self, tmp_path):
     shm_before = _shm_entries()
     config = _tiny_config(
@@ -359,6 +361,7 @@ class TestFleetLifecycle:
     del fleet
     _assert_no_new_shm(shm_before)
 
+  @pytest.mark.slow
   def test_learner_death_detected_and_actors_exit(self, tmp_path):
     shm_before = _shm_entries()
     config = _tiny_config(learner_crash_after_steps=4)
@@ -371,6 +374,7 @@ class TestFleetLifecycle:
     del fleet
     _assert_no_new_shm(shm_before)
 
+  @pytest.mark.slow
   def test_actor_abort_policy_takes_fleet_down(self, tmp_path):
     config = _tiny_config(
         actor_crash_after_episodes=1, actor_crash_mode="hard",

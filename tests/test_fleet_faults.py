@@ -372,6 +372,7 @@ def _committed(metrics):
 class TestFleetFaultsE2E:
   """Real multi-process recoveries through the real seams."""
 
+  @pytest.mark.slow
   def test_restart_budget_trips_on_crash_looping_actor(self, tmp_path):
     # A recurring crash re-fires in EVERY incarnation: the rate budget
     # must trip instead of respawning forever.
@@ -386,6 +387,7 @@ class TestFleetFaultsE2E:
       fleet.run()
     assert fleet._restarts[0] == 2  # two respawns, then the trip
 
+  @pytest.mark.slow
   def test_elastic_scale_up_down_lands_no_partial_rows(self, tmp_path):
     config = _tiny_config(max_train_steps=24)
     fleet = Fleet(config, str(tmp_path / "m"))
@@ -410,6 +412,7 @@ class TestFleetFaultsE2E:
     assert actions == ["add", "remove", "remove"]
     assert fleet._restarts.get(0, 0) == 0  # drains never read as crashes
 
+  @pytest.mark.slow
   def test_actor_crash_recovers_with_mttr_and_no_partial_rows(
       self, tmp_path):
     # One planned mid-episode crash: the disconnect abort discards the

@@ -413,6 +413,7 @@ class TestSentinelFleetE2E:
   exactly one alert train → flight records land, role-named, exactly
   like the hang path's."""
 
+  @pytest.mark.slow
   def test_slow_host_pages_with_flight_record(self, tmp_path):
     from tensor2robot_tpu import config as gin
     from tensor2robot_tpu.fleet import Fleet, FleetConfig

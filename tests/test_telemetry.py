@@ -349,6 +349,7 @@ class TestFlightRecorder:
     assert any(s["name"] == "last_op" for s in dump["spans"])
     assert dump["metrics"]["counters"]["replay.adds"] == 5.0
 
+  @pytest.mark.slow
   def test_flight_record_on_latched_fleet_error(self, tmp_path):
     """The crash-policy harness (tests/test_fleet.py): an injected
     learner crash latches a FleetError — and now every reachable

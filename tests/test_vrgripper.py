@@ -228,6 +228,7 @@ class TestMetaBCModels:
     assert np.isfinite(float(metrics["loss"]))
     assert "post_adaptation_loss" in metrics
 
+  @pytest.mark.slow
   def test_snail_train_step_and_predict(self):
     model = VRGripperSNAILModel(
         image_size=IMG, filters=(8,), embedding_size=16,
