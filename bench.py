@@ -152,9 +152,16 @@ Usage: python bench.py [--paper] [--profile DIR] [--input] [--replay]
              post-warmup calls, D2H-barriered), SavedModel host-CPU
              signature latency, and the micro-batcher's
              throughput-vs-concurrency curve vs sequential
-             single-request dispatch. With --dry-run: one tiny bucket
-             on the local backend, no BENCH_DETAIL.json write — the
-             tier-1 smoke of the serving bench path itself.
+             single-request dispatch. The REPLICATED tier rides the
+             same flag (serving_replicated section, ISSUE 17): real
+             front-host processes over TCP behind the consistent-hash
+             router — goodput vs replica count (1/2/4), skewed-tenant
+             p99, a mid-traffic replica kill with shed time gated,
+             the speculative-CEM p50 A/B, and the observation-dedup
+             hit-rate leg. With --dry-run: one tiny bucket on the
+             local backend plus a tiny 2-front replicated smoke, no
+             BENCH_DETAIL.json write — the tier-1 smoke of the
+             serving bench path itself.
 """
 
 from __future__ import annotations
@@ -3217,6 +3224,530 @@ def bench_serving_front(dry_run: bool = False):
     shutil.rmtree(work, ignore_errors=True)
 
 
+def bench_serving_replicated(dry_run: bool = False):
+  """The REPLICATED serving tier (ISSUE 17): real front-host
+  processes over TCP behind the consistent-hash router.
+
+  Every leg runs against REAL `fleet.front.front_main` processes
+  (spawn, own jax runtime, the full ServingFront stack behind the
+  fleet RPC envelope) with `serving.ServingRouter` doing caller-side
+  rendezvous placement — the production data path, not a simulation:
+
+    * goodput vs REPLICA COUNT (1/2/4) under open-loop Poisson
+      arrivals that scale WITH the replica count (weak scaling: the
+      per-replica offered load is fixed below one replica's measured
+      capacity, so the 1→2 goodput ratio shows whether replica 2 adds
+      real capacity). The ≥1.7× gate is ENFORCED only when the host
+      has the cores to show parallel speedup (the PR-16 caveat
+      pattern: two front processes + the driver cannot scale on a
+      1-core rig; the measured ratio + caveat are recorded either
+      way).
+    * SKEWED TENANT: one hot tenant spread over both replicas
+      (`spread=2`) next to background tenants — per-tenant p99 vs the
+      calibrated SLO.
+    * PUBLISH FAN-OUT + DEDUP: one `publish` to the tree root must
+      reach EVERY replica (hard gate); the router's observation-dedup
+      cache then serves duplicated frames at ≥50% hit rate (hard
+      gate) and a publish invalidates it (the first post-publish
+      repeat MUST miss — hard gate).
+    * REPLICA KILL mid-traffic: hard-kill the hot tenant's home
+      replica under background load — the router must fail its
+      tenants over to the survivor inside the same predict() call
+      (shed time recorded + gated; zero NoReplicasError allowed).
+    * SPECULATIVE CEM p50 A/B (in-process): the 1-iteration program
+      inline vs the full program, plus the refined-cache hit path —
+      p50 reduction gated on full runs, the serve/refine contract
+      gated always.
+
+  The tenant model stays tiny (the front bench's argument: routing,
+  placement, failover, and cache contracts are request-level, not
+  FLOPs-level — a small program keeps arrival rates high enough to
+  stress the tier on CPU).
+  """
+  import random as _random
+  import subprocess
+  import threading
+
+  from tensor2robot_tpu.fleet import FleetConfig
+  from tensor2robot_tpu.fleet import rpc as rpc_lib
+  from tensor2robot_tpu.fleet.front import FrontTier
+  from tensor2robot_tpu.fleet.host import _build_learner, _client_kwargs
+  from tensor2robot_tpu.serving import (
+      NoReplicasError,
+      ServingRouter,
+      SpeculativeCEM,
+  )
+  from tensor2robot_tpu.specs import make_random_tensors
+
+  tiny = dry_run
+  point_secs = 0.75 if tiny else 6.0
+  workers_per_tenant = 2 if tiny else 4
+  cores = os.cpu_count() or 1
+
+  configs_dir = os.path.join(
+      os.path.dirname(os.path.abspath(__file__)), "tensor2robot_tpu",
+      "research", "qtopt", "configs")
+  gate_gin = os.path.join(configs_dir, "qtopt_serving_replicated.gin")
+  gate = subprocess.run(
+      [sys.executable, "-m", "tensor2robot_tpu.bin.run_t2r_trainer",
+       "--validate_only", "--gin_configs", gate_gin],
+      capture_output=True, text=True, timeout=300)
+  if gate.returncode != 0:
+    raise SystemExit(
+        f"replicated serving launch gate rejected {gate_gin!r} "
+        f"(validate_only exit {gate.returncode}):\n"
+        f"{gate.stdout}\n{gate.stderr}")
+
+  tenants = (("hot", "bg0", "bg1") if tiny
+             else ("hot", "bg0", "bg1", "bg2", "bg3"))
+
+  def _config(num_fronts, speculative=False, spread=1):
+    # Tiny CEM tenants on purpose (see the docstring); iterations=2 so
+    # the speculative fast program has something to cut.
+    return FleetConfig(
+        num_actors=1, env="mujoco_pose", image_size=16, action_dim=2,
+        torso_filters=(8,), head_filters=(8,), dense_sizes=(16,),
+        cem_population=8, cem_iterations=2, cem_elites=2,
+        serve_max_batch=4 if tiny else 8,
+        transport="tcp", broadcast_degree=2,
+        front_hosts=num_fronts, front_tenants=tenants,
+        front_spread=spread, speculative_cem=speculative,
+        launch_timeout_secs=240.0, seed=0)
+
+  base_config = _config(1)
+  learner = _build_learner(base_config)
+  obs1 = make_random_tensors(
+      learner.observation_specification(), batch_size=1, seed=0)
+
+  def _router(tier, spread=1, dedup_capacity=0):
+    return ServingRouter(
+        tier.addresses, authkey=tier._config.authkey,
+        transport="tcp", spread=spread,
+        dedup_capacity=dedup_capacity)
+
+  def run_router_open_loop(router, rates, duration, seed=0):
+    """Open-loop Poisson arrivals through the ROUTER: per tenant a
+    precomputed arrival schedule drained by a small worker pool, so
+    arrivals never wait for completions and queueing delay (waiting
+    for a free worker) counts against latency — the same open-loop
+    semantics as the front bench, over real sockets."""
+    stats = {t: {"offered": 0, "shed": 0, "errors": 0,
+                 "latencies": []}
+             for t in rates}
+    lock = threading.Lock()
+    start = time.perf_counter() + 0.05  # common epoch for schedules
+    threads = []
+
+    def worker(tenant, arrivals, cursor):
+      entry = stats[tenant]
+      while True:
+        with lock:
+          i = cursor["i"]
+          if i >= len(arrivals):
+            return
+          cursor["i"] = i + 1
+        due = start + arrivals[i]
+        now = time.perf_counter()
+        if due > now:
+          time.sleep(due - now)
+        try:
+          router.predict(tenant, obs1)
+        except rpc_lib.RpcError:
+          with lock:
+            entry["shed"] += 1
+        except (NoReplicasError, TimeoutError, ConnectionError):
+          with lock:
+            entry["errors"] += 1
+        else:
+          latency = (time.perf_counter() - due) * 1e3
+          with lock:
+            entry["latencies"].append(latency)
+
+    for index, (tenant, rate) in enumerate(sorted(rates.items())):
+      rng = _random.Random(seed + index)
+      arrivals, t = [], rng.expovariate(rate)
+      while t < duration:
+        arrivals.append(t)
+        t += rng.expovariate(rate)
+      stats[tenant]["offered"] = len(arrivals)
+      cursor = {"i": 0}
+      for _ in range(workers_per_tenant):
+        threads.append(threading.Thread(
+            target=worker, args=(tenant, arrivals, cursor)))
+    t0 = time.perf_counter()
+    for thread in threads:
+      thread.start()
+    for thread in threads:
+      thread.join()
+    wall = time.perf_counter() - t0
+    with lock:
+      return {t: dict(s) for t, s in stats.items()}, wall
+
+  def summarize(stats, wall, slo_ms, duration):
+    # The front bench's two-denominator rule: offered over the Poisson
+    # window, completions/goodput over the full wall (conservative at
+    # saturation).
+    latencies = np.concatenate(
+        [np.asarray(s["latencies"], np.float64)
+         for s in stats.values() if s["latencies"]]
+        or [np.zeros(0)])
+    offered = sum(s["offered"] for s in stats.values())
+    completed = int(latencies.size)
+    good = int((latencies <= slo_ms).sum()) if completed else 0
+    out = {
+        "offered_rps": round(offered / duration, 1),
+        "completed_rps": round(completed / wall, 1),
+        "goodput_rps": round(good / wall, 1),
+        "shed": sum(s["shed"] for s in stats.values()),
+        "errors": sum(s["errors"] for s in stats.values()),
+        "in_slo_fraction": round(good / completed, 4) if completed
+        else 0.0,
+    }
+    if completed:
+      for q, key in ((50, "p50_ms"), (95, "p95_ms"), (99, "p99_ms")):
+        out[key] = round(float(np.percentile(latencies, q)), 2)
+    return out
+
+  detail = {
+      "config": (f"replicated front tier over TCP: tiny CEM tenants "
+                 f"(population=8, iterations=2), "
+                 f"{len(tenants)} tenants, router placement = "
+                 "rendezvous hash (replay.sampler seam)"),
+      "device_kind": jax.devices()[0].device_kind,
+      "host_cores": cores,
+      "transport": "tcp",
+      "launch_gate": ("run_t2r_trainer --validate_only "
+                      "qtopt_serving_replicated.gin (passed)"),
+      "methodology": (
+          "real front_main processes (spawn, own jax runtime) behind "
+          "ServingRouter; open loop = precomputed Poisson schedules "
+          "drained by fixed worker pools (queue wait counts against "
+          "latency); replica-count legs scale offered load WITH the "
+          "replica count (weak scaling) at a fixed per-replica "
+          "fraction of the measured single-caller capacity"),
+  }
+
+  tiers = {}
+
+  def _tier(count):
+    if count not in tiers:
+      tiers[count] = FrontTier(_config(count), count).launch()
+    return tiers[count]
+
+  try:
+    # ---- calibration: closed-loop p50 THROUGH the router ----
+    tier1 = _tier(1)
+    router = _router(tier1)
+    for _ in range(3):
+      router.predict("bg0", obs1)
+    samples = []
+    for _ in range(5 if tiny else 30):
+      t0 = time.perf_counter()
+      router.predict("bg0", obs1)
+      samples.append((time.perf_counter() - t0) * 1e3)
+    router.close()
+    p50_1 = float(np.percentile(samples, 50))
+    seq_rps = 1e3 / p50_1
+    slo_ms = max(20.0, 5.0 * p50_1)
+    detail["calibration"] = {
+        "closed_loop_p50_ms": round(p50_1, 2),
+        "sequential_rps": round(seq_rps, 1),
+        "slo_ms": round(slo_ms, 1),
+    }
+
+    # ---- (a) goodput vs replica count (weak scaling) ----
+    counts = (1, 2) if tiny else (1, 2, 4)
+    per_replica_offered = 0.8 * seq_rps
+    sweep = []
+    for count in counts:
+      tier = _tier(count)
+      router = _router(tier)
+      total = per_replica_offered * count
+      bg = [t for t in tenants]
+      rates = {t: total / len(bg) for t in bg}
+      stats, wall = run_router_open_loop(router, rates, point_secs)
+      point = summarize(stats, wall, slo_ms, point_secs)
+      point["replicas"] = count
+      point["router"] = router.stats()
+      router.close()
+      sweep.append(point)
+    detail["goodput_vs_replicas"] = sweep
+    by_count = {p["replicas"]: p for p in sweep}
+    scaling = round(
+        by_count[2]["goodput_rps"]
+        / max(by_count[1]["goodput_rps"], 1e-9), 2)
+    scaling_enforced = (not tiny) and cores >= 4
+    detail["scaling_1_to_2"] = scaling
+    detail["scaling_gate"] = {
+        "threshold": 1.7,
+        "enforced": scaling_enforced,
+        "note": (
+            "gate enforced" if scaling_enforced else
+            f"gate recorded, not enforced: two front processes + the "
+            f"driver cannot show parallel speedup on this "
+            f"{cores}-core rig (the PR-16 host-core caveat pattern; "
+            "re-run on a multi-core host to enforce)"),
+    }
+    if scaling_enforced and scaling < 1.7:
+      raise SystemExit(
+          f"replicated serving gate FAILED: goodput scaled only "
+          f"{scaling}x from 1→2 replicas (need >= 1.7x on this "
+          f"{cores}-core host); refusing to commit.")
+
+    # ---- (b) skewed tenant: hot spread over both replicas ----
+    tier2 = _tier(2)
+    router = _router(tier2, spread=2)
+    hot_rate = 0.5 * seq_rps
+    bg_rate = 0.1 * seq_rps
+    rates = {"hot": hot_rate}
+    rates.update({t: bg_rate for t in tenants if t != "hot"})
+    stats, wall = run_router_open_loop(router, rates, point_secs,
+                                       seed=7)
+    skew = {
+        "spread": 2,
+        "slo_ms": round(slo_ms, 1),
+        "hot": summarize({"x": stats["hot"]}, wall, slo_ms,
+                         point_secs),
+        "background": {
+            t: summarize({"x": stats[t]}, wall, slo_ms, point_secs)
+            for t in rates if t != "hot"},
+    }
+    skew["held_p99"] = all(
+        row.get("p99_ms", float("inf")) <= slo_ms
+        for row in [skew["hot"], *skew["background"].values()])
+    router.close()
+    detail["skewed_tenant"] = skew
+    if scaling_enforced and not skew["held_p99"]:
+      raise SystemExit(
+          "replicated serving gate FAILED: a tenant's p99 broke the "
+          f"SLO with a skewed hot tenant (slo={slo_ms:.0f}ms): "
+          f"{json.dumps(skew)}; refusing to commit.")
+
+    # ---- (c) publish fan-out + dedup hit rate + invalidation ----
+    state0 = learner.create_state(jax.random.PRNGKey(0), batch_size=2)
+    acting0 = state0.train_state.replace(opt_state=None)
+    version = tier2.publish(acting0, step=10)
+    fanout = {}
+    for index in sorted(tier2.addresses):
+      client = tier2._client(index)
+      try:
+        fanout[index] = client.call("metrics_scalars", {})[
+            "front_publishes"]
+      finally:
+        if index != 0:
+          client.close()
+    detail["publish_fanout"] = {
+        "published_version": version,
+        "front_publishes": {str(i): v for i, v in fanout.items()},
+    }
+    if any(v < 1 for v in fanout.values()):
+      raise SystemExit(
+          "replicated serving gate FAILED: a publish to the tree root "
+          f"did not reach every front replica ({fanout}); refusing "
+          "to commit.")
+
+    router = _router(tier2, dedup_capacity=64)
+    router.notify_published(version)
+    unique = 3 if tiny else 10
+    requests = 30 if tiny else 200
+    frames = [make_random_tensors(
+        learner.observation_specification(), batch_size=1, seed=100 + i)
+        for i in range(unique)]
+    before = router.dedup_stats()
+    for i in range(requests):
+      router.predict("bg0", frames[i % unique])
+    after = router.dedup_stats()
+    hits = after["hits"] - before["hits"]
+    hit_rate = round(hits / requests, 3)
+    # Publish again: the FIRST repeat of a hot frame must miss (the
+    # cached action was computed under the old params).
+    version = tier2.publish(acting0, step=20)
+    router.notify_published(version)
+    miss_before = router.dedup_stats()["misses"]
+    router.predict("bg0", frames[0])
+    missed_after_publish = (router.dedup_stats()["misses"]
+                            - miss_before) >= 1
+    hit_before = router.dedup_stats()["hits"]
+    router.predict("bg0", frames[0])
+    rehit_after_publish = (router.dedup_stats()["hits"]
+                           - hit_before) >= 1
+    detail["dedup"] = {
+        "unique_frames": unique,
+        "requests": requests,
+        "hit_rate": hit_rate,
+        "expected_hit_rate": round(1 - unique / requests, 3),
+        "missed_after_publish": missed_after_publish,
+        "rehit_after_repeat": rehit_after_publish,
+    }
+    if hit_rate < 0.5:
+      raise SystemExit(
+          f"replicated serving gate FAILED: dedup hit rate "
+          f"{hit_rate} under {requests} requests over {unique} "
+          "unique frames (expected ~"
+          f"{detail['dedup']['expected_hit_rate']}); refusing to "
+          "commit.")
+    if not missed_after_publish:
+      raise SystemExit(
+          "replicated serving gate FAILED: a dedup entry survived a "
+          "param publish (the first post-publish repeat HIT); "
+          "refusing to commit.")
+    router.close()
+
+    # ---- (d) replica kill mid-traffic: shed to the survivor ----
+    router = _router(tier2)
+    router.predict("hot", obs1)  # warm the pool
+    victim = router.placement("hot")[0]
+    survivor = [i for i in tier2.addresses if i != victim]
+    stop_bg = threading.Event()
+    bg_errors = {"count": 0, "served": 0}
+
+    def background():
+      while not stop_bg.is_set():
+        try:
+          router.predict("bg0", obs1)
+          bg_errors["served"] += 1
+        except rpc_lib.RpcError:
+          pass
+        except (NoReplicasError, TimeoutError, ConnectionError):
+          bg_errors["count"] += 1
+        time.sleep(0.01)
+
+    bg_thread = threading.Thread(target=background)
+    bg_thread.start()
+    time.sleep(0.2)
+    failovers_before = router.stats()["failovers"]
+    tier2.kill(victim)
+    t_kill = time.perf_counter()
+    router.predict("hot", obs1)  # fails over INSIDE this call
+    shed_ms = (time.perf_counter() - t_kill) * 1e3
+    stop_bg.set()
+    bg_thread.join()
+    placement_after = router.placement("hot")
+    kill_detail = {
+        "victim": victim,
+        "survivors": survivor,
+        "shed_ms": round(shed_ms, 1),
+        "failovers": router.stats()["failovers"] - failovers_before,
+        "background_errors_during_kill": bg_errors["count"],
+        "background_served": bg_errors["served"],
+        "placement_after_kill": placement_after,
+    }
+    router.close()
+    detail["replica_kill"] = kill_detail
+    if victim in placement_after:
+      raise SystemExit(
+          f"replicated serving gate FAILED: the killed replica "
+          f"{victim} is still in the placement ({placement_after}); "
+          "refusing to commit.")
+    if kill_detail["failovers"] < 1 or shed_ms > 10_000:
+      raise SystemExit(
+          f"replicated serving gate FAILED: replica kill did not "
+          f"shed within budget (shed_ms={shed_ms:.0f}, "
+          f"failovers={kill_detail['failovers']}); refusing to "
+          "commit.")
+    if bg_errors["count"] > 0:
+      raise SystemExit(
+          f"replicated serving gate FAILED: {bg_errors['count']} "
+          "background requests died during the kill despite a live "
+          "survivor; refusing to commit.")
+
+    # ---- (e) speculative CEM p50 A/B (in-process) ----
+    full_fn = jax.jit(learner.build_policy())
+    fast_fn = jax.jit(learner.build_policy(cem_iterations=1))
+    rng_box = {"rng": jax.random.PRNGKey(42)}
+
+    def _call(fn, feats):
+      rng_box["rng"], sub = jax.random.split(rng_box["rng"])
+      return np.asarray(fn(acting0, feats, sub))
+
+    version_box = {"v": 0}
+    spec = SpeculativeCEM(
+        fast_predict=lambda f: _call(fast_fn, f),
+        full_predict=lambda f: _call(full_fn, f),
+        version_fn=lambda: version_box["v"])
+    calls = 10 if tiny else 50
+    probes = [make_random_tensors(
+        learner.observation_specification(), batch_size=1,
+        seed=500 + i) for i in range(calls)]
+    _call(full_fn, probes[0])  # compile both programs off the clock
+    _call(fast_fn, probes[0])
+    full_lat, spec_lat = [], []
+    for probe in probes:
+      t0 = time.perf_counter()
+      _call(full_fn, probe)
+      full_lat.append((time.perf_counter() - t0) * 1e3)
+    for probe in probes:
+      # every probe is a distinct frame: each speculative call is a
+      # cache MISS, i.e. the fast program inline — the honest p50 of
+      # the speculative serve path.
+      t0 = time.perf_counter()
+      spec.predict(probe)
+      spec_lat.append((time.perf_counter() - t0) * 1e3)
+    p50_full = float(np.percentile(full_lat, 50))
+    p50_spec = float(np.percentile(spec_lat, 50))
+    ratio = round(p50_full / max(p50_spec, 1e-9), 2)
+    # The refined-hit path: repeat one frame after the refinement
+    # lands — it must serve from the refined cache.
+    spec.flush(timeout_secs=10.0)
+    deadline = time.monotonic() + 10.0
+    while (spec.stats()["refines"] < 1
+           and time.monotonic() < deadline):
+      time.sleep(0.01)
+    spec.predict(probes[-1])
+    spec_stats = spec.stats()
+    spec.close()
+    detail["speculative_cem"] = {
+        "cem_iterations_full": 2,
+        "p50_full_ms": round(p50_full, 2),
+        "p50_speculative_ms": round(p50_spec, 2),
+        "p50_reduction_x": ratio,
+        "fast_served": spec_stats["fast_served"],
+        "refined_served": spec_stats["refined_served"],
+        "refines": spec_stats["refines"],
+        "refine_dropped": spec_stats["refine_dropped"],
+    }
+    # The ratio gate needs the refine worker to own a core: while a
+    # fast call is being timed, the PREVIOUS probe's full-CEM
+    # refinement is computing in the background thread — on a 1-core
+    # rig the two serialize and speculative p50 reads as fast+full
+    # (the PR-16 caveat pattern; the serve/refine CONTRACT gate below
+    # is timing-free and enforced everywhere).
+    ratio_enforced = (not tiny) and cores >= 2
+    detail["speculative_cem"]["gate_enforced"] = ratio_enforced
+    detail["speculative_cem"]["note"] = (
+        "gate enforced" if ratio_enforced else
+        f"p50-reduction gate unverifiable on this {cores}-core host "
+        "(the background refinement serializes with the timed fast "
+        "path); measured ratio recorded")
+    if spec_stats["fast_served"] < 1 or spec_stats["refined_served"] < 1:
+      raise SystemExit(
+          "replicated serving gate FAILED: the speculative serve/"
+          f"refine contract did not exercise ({spec_stats}); "
+          "refusing to commit.")
+    if ratio_enforced and ratio < 1.2:
+      raise SystemExit(
+          f"replicated serving gate FAILED: speculative CEM cut p50 "
+          f"only {ratio}x vs the full 2-iteration program (need >= "
+          "1.2x); refusing to commit.")
+
+    detail["conclusion"] = (
+        f"replicated tier over TCP: goodput {scaling}x from 1→2 "
+        f"replicas ({detail['scaling_gate']['note']}); skewed-tenant "
+        f"p99 {'held' if skew['held_p99'] else 'BROKE'} the "
+        f"{slo_ms:.0f}ms SLO; a replica kill shed its tenants to the "
+        f"survivor in {kill_detail['shed_ms']:.0f}ms inside one "
+        "predict() call with zero background errors; publish fan-out "
+        "reached every replica; dedup served "
+        f"{detail['dedup']['hit_rate']:.0%} of duplicated frames "
+        "from cache and invalidated on publish; speculative CEM cut "
+        f"p50 {ratio}x vs the full program "
+        f"({detail['speculative_cem']['note']}).")
+    return detail
+  finally:
+    for tier in tiers.values():
+      tier.close()
+
+
 def _bench_savedmodel_host_latency(calls: int = 100):
   """serving_default latency of the exported policy net on host CPU.
 
@@ -3597,6 +4128,12 @@ def main():
     # reload recompiles.
     smoke = bench_serving(dry_run=True)
     front_smoke = bench_serving_front(dry_run=True)
+    # The replicated-tier smoke (ISSUE 17): real 2-front TCP tier +
+    # router — the publish fan-out, dedup invalidate-on-publish,
+    # replica-kill shed, and speculative serve/refine gates all
+    # HARD-FAIL the smoke (the core-bound scaling/SLO gates are
+    # recorded, not enforced, on small hosts).
+    rep_smoke = bench_serving_replicated(dry_run=True)
     print(json.dumps({
         "serving_dry_run": "ok",
         "device_kind": smoke["device_kind"],
@@ -3610,6 +4147,12 @@ def main():
         "front_reloads": front_smoke["arena_eviction"]["reloads"],
         "front_reload_cache_misses":
             front_smoke["arena_eviction"]["reload_cache_misses"],
+        "replicated_scaling_1_to_2": rep_smoke["scaling_1_to_2"],
+        "replicated_shed_ms":
+            rep_smoke["replica_kill"]["shed_ms"],
+        "replicated_dedup_hit_rate": rep_smoke["dedup"]["hit_rate"],
+        "replicated_speculative_p50_reduction_x":
+            rep_smoke["speculative_cem"]["p50_reduction_x"],
     }))
     return
   profile_dir = None
@@ -3744,6 +4287,11 @@ def main():
     # reload gate (ISSUE 13; ordered after the closed-loop leg so the
     # front's throwaway compile cache never shadows it).
     detail["serving_multitenant"] = bench_serving_front()
+    # The replicated tier (ISSUE 17): real front hosts over TCP
+    # behind the consistent-hash router — goodput vs replica count,
+    # skewed-tenant p99, mid-traffic replica kill, speculative p50,
+    # dedup hit rate (each with its refuse-to-commit gate).
+    detail["serving_replicated"] = bench_serving_replicated()
   if "--fleet" in args:
     detail["fleet"] = bench_fleet()
   if "--chaos" in args:

@@ -58,6 +58,11 @@ def home_shard(actor_id: str, num_shards: int) -> int:
   set changes, ONLY the actors homed on a removed shard remap —
   everyone else's episodes keep landing where they always did
   (pinned by tests/test_fleet_transport.py).
+
+  The canonical, bucket-set-generalized form of this rule lives in
+  `replay.sampler.rendezvous_choose` (the serving router places
+  tenants with it); this module must stay jax-free and so keeps a
+  local copy, pinned byte-identical by tests/test_serving_router.py.
   """
   if num_shards <= 0:
     raise ValueError(f"num_shards must be positive, got {num_shards}")

@@ -62,9 +62,17 @@ RPC_DELAY = "rpc_delay"              # client-side added latency
 RPC_DROP = "rpc_drop"                # request lost: deadline + retry
 RPC_DISCONNECT = "rpc_disconnect"    # server drops the connection
 SLOW_HOST = "slow_host"              # server-side handler stall
+SERVING_REPLICA_CRASH = "serving_replica_crash"  # front replica dies
 
 FAULT_CLASSES = (ACTOR_CRASH, ACTOR_HANG, LEARNER_CRASH, RPC_DELAY,
                  RPC_DROP, RPC_DISCONNECT, SLOW_HOST)
+
+# The full taxonomy. `FAULT_CLASSES` stays the 7-class default set so
+# `FaultPlan.generate`'s seeded digest pin holds; serving_replica_crash
+# (ISSUE 17 — a replicated-front host hard-exits mid-traffic, the
+# router must reshed its tenants) is opt-in: it only generates when a
+# caller asks for it AND declares `num_fronts`.
+ALL_FAULT_CLASSES = FAULT_CLASSES + (SERVING_REPLICA_CRASH,)
 
 # Which process injects each class: client-side faults run in the
 # caller (actor/learner), server-side faults run in the host's RPC
@@ -141,7 +149,8 @@ class FaultPlan:
                rpc_call_range: Tuple[int, int] = (4, 16),
                hang_secs: float = 20.0,
                delay_secs: float = 0.2,
-               stall_secs: float = 0.3) -> "FaultPlan":
+               stall_secs: float = 0.3,
+               num_fronts: int = 0) -> "FaultPlan":
     """One event per requested class, targets/triggers drawn from a
     `random.Random(seed)` stream — same seed, same plan, any host.
 
@@ -152,10 +161,18 @@ class FaultPlan:
     rng = random.Random(seed)
     events: List[FaultEvent] = []
     for fault in classes:
-      if fault not in FAULT_CLASSES:
+      if fault not in ALL_FAULT_CLASSES:
         raise ValueError(
-            f"unknown fault class {fault!r}; one of {FAULT_CLASSES}")
-      if fault in (ACTOR_CRASH, ACTOR_HANG):
+            f"unknown fault class {fault!r}; one of "
+            f"{ALL_FAULT_CLASSES}")
+      if fault == SERVING_REPLICA_CRASH:
+        if num_fronts < 1:
+          raise ValueError(
+              "serving_replica_crash needs num_fronts >= 1")
+        events.append(FaultEvent(
+            fault=fault, target=f"front-{rng.randrange(num_fronts)}",
+            at=rng.randint(*rpc_call_range), mode="hard"))
+      elif fault in (ACTOR_CRASH, ACTOR_HANG):
         target = f"actor-{rng.randrange(num_actors)}"
         at = rng.randint(*actor_batch_range)
         mode = (rng.choice(("raise", "hard", "mid_episode"))
@@ -248,6 +265,23 @@ class FaultInjector:
         event = armed.event
         if (event.fault in (ACTOR_CRASH, ACTOR_HANG)
             and armed.remaining > 0 and batch_index >= event.at):
+          armed.remaining = 0
+          break
+      else:
+        return None
+    self._record_injection(event, flight_record=True)
+    return event
+
+  def on_serve(self, serve_index: int) -> Optional[FaultEvent]:
+    """Serving-front seam: called per predict dispatch by a front
+    replica host (`fleet.front`). Returns the due
+    serving_replica_crash event (recorded + flight-dumped) or None —
+    the host then hard-exits and the router/orchestrator recover."""
+    with self._lock:
+      for armed in self._armed:
+        event = armed.event
+        if (event.fault == SERVING_REPLICA_CRASH
+            and armed.remaining > 0 and serve_index >= event.at):
           armed.remaining = 0
           break
       else:

@@ -55,6 +55,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 from tensor2robot_tpu import config as gin
 from tensor2robot_tpu.fleet import actor as actor_lib
 from tensor2robot_tpu.fleet import faults as faults_lib
+from tensor2robot_tpu.fleet import front as front_lib
 from tensor2robot_tpu.fleet import host as host_lib
 from tensor2robot_tpu.fleet import learner as learner_lib
 from tensor2robot_tpu.fleet.rpc import RpcClient, TRANSPORTS
@@ -155,6 +156,29 @@ class FleetConfig:
   serving_hosts: int = 1
   replay_hosts: int = 0
   broadcast_degree: int = 2
+  # Replicated serving-front tier (ISSUE 17). front_hosts > 0 spawns
+  # that many `fleet.front.front_main` replicas — each a complete
+  # multi-tenant ServingFront (arena + admission + continuous
+  # batching) behind the fleet RPC transport. They join the SAME
+  # broadcast tree as the serving hosts (one learner uplink fans to
+  # both kinds), callers place tenants over them with
+  # `serving.router.ServingRouter` (rendezvous hashing,
+  # `front_spread`-wide hot-tenant spread), and — unlike serving
+  # replicas/shards — a front replica death is SURVIVABLE: the router
+  # sheds its tenants to HRW survivors and the orchestrator records
+  # the membership change instead of latching a fleet error.
+  front_hosts: int = 0
+  front_tenants: Tuple[str, ...] = ("policy",)
+  front_spread: int = 1
+  front_slo_ms: float = 100.0
+  # speculative_cem: each front tenant serves the 1-iteration CEM
+  # program inline and refines with the full program in the
+  # background (serving.speculative — refined actions are
+  # version-stamped, never served across a param hot-swap).
+  speculative_cem: bool = False
+  # Router-side observation-dedup cache entries (0 disables);
+  # identical quantized frames short-circuit at the caller.
+  dedup_capacity: int = 0
   # Lifecycle. The restart budget is RATE-based (ISSUE 14): a crashed
   # actor may be respawned up to `max_actor_restarts` times per
   # `restart_window_secs` sliding window — a crash-loop trips the
@@ -255,6 +279,21 @@ class FleetConfig:
           "serving_hosts > 1 requires replay_hosts >= 1: serving "
           "replicas are engine-only (no replay store), so the replay "
           "plane must live on dedicated shard hosts")
+    if self.front_hosts < 0:
+      raise ValueError(
+          f"front_hosts must be >= 0, got {self.front_hosts}")
+    if self.front_spread < 1:
+      raise ValueError(
+          f"front_spread must be >= 1, got {self.front_spread}")
+    if self.front_hosts and self.front_spread > self.front_hosts:
+      raise ValueError(
+          f"front_spread ({self.front_spread}) cannot exceed "
+          f"front_hosts ({self.front_hosts})")
+    if not self.front_tenants:
+      raise ValueError("front_tenants must name at least one tenant")
+    if self.dedup_capacity < 0:
+      raise ValueError(
+          f"dedup_capacity must be >= 0, got {self.dedup_capacity}")
     if self.fault_plan is not None and not isinstance(
         self.fault_plan, faults_lib.FaultPlan):
       raise ValueError(
@@ -313,6 +352,11 @@ class Fleet:
     # shutdown barrier can read final metrics from each.
     self._serving: Dict[int, mp.Process] = {}
     self._shards: Dict[int, mp.Process] = {}
+    # Replicated front tier (ISSUE 17): front replica death is the
+    # one SURVIVABLE host-class failure — lost replicas move to
+    # `front_failures` and the membership shrinks.
+    self._fronts: Dict[int, mp.Process] = {}
+    self.front_failures: List[Dict[str, Any]] = []
     # One persistent control entry per extra host: {name, address,
     # client} — client opened lazily, dropped on poisoning like the
     # root control channel.
@@ -456,6 +500,21 @@ class Fleet:
                "address": None, "client": None}
       self._aux_hosts.append(entry)
       pending.append((entry, parent_conn, process, f"replay shard {i}"))
+    for i in range(getattr(config, "front_hosts", 0)):
+      name = f"t2r-fleet-front-{i}"
+      parent_conn, child_conn = self._ctx.Pipe()
+      process = self._ctx.Process(
+          target=front_lib.front_main,
+          args=(config, i, self._address, child_conn, self._host_stop,
+                self._heartbeat(name)),
+          name=name, daemon=True)
+      process.start()
+      child_conn.close()
+      self._fronts[i] = process
+      entry = {"kind": "front", "index": i, "name": f"front{i}",
+               "address": None, "client": None}
+      self._aux_hosts.append(entry)
+      pending.append((entry, parent_conn, process, f"front host {i}"))
     deadline = time.monotonic() + config.launch_timeout_secs
     for entry, parent_conn, process, what in pending:
       remaining = max(0.0, deadline - time.monotonic())
@@ -496,28 +555,38 @@ class Fleet:
       raise
 
   def _configure_broadcast(self, config: FleetConfig) -> None:
-    """Wires the d-ary publication tree over the serving hosts: each
-    host learns its forward set and its depth (stamped into act
-    replies as `params_hop` for per-hop lag attribution)."""
-    serving = self._addresses["serving"]
-    if len(serving) < 2:
+    """Wires the d-ary publication tree over the serving hosts AND
+    the front replicas: one combined heap layout (serving hosts
+    first, fronts after), so the learner's single uplink fans to
+    every engine AND every front arena. Each host learns its forward
+    set and its depth (stamped into act replies as `params_hop` for
+    per-hop lag attribution)."""
+    serving = list(self._addresses["serving"])
+    front_entries = [entry for entry in self._aux_hosts
+                     if entry["kind"] == "front"]
+    combined = serving + [entry["address"] for entry in front_entries]
+    if len(combined) < 2:
       return  # single serving host: root defaults (no children, hop 0)
-    depths = broadcast_depths(len(serving), config.broadcast_degree)
+    depths = broadcast_depths(len(combined), config.broadcast_degree)
     replicas = [entry for entry in self._aux_hosts
                 if entry["kind"] == "serving"]
-    for i in range(len(serving)):
-      children = [list(serving[c]) for c in broadcast_children(
-          i, len(serving), config.broadcast_degree)]
+    for i in range(len(combined)):
+      children = [list(combined[c]) for c in broadcast_children(
+          i, len(combined), config.broadcast_degree)]
       payload = {"children": children, "depth": depths[i]}
       if i == 0:
         self._control.call("configure_broadcast", payload,
                            timeout_secs=30.0)
-      else:
+      elif i < len(serving):
         self._aux_call(replicas[i - 1], "configure_broadcast", payload,
+                       timeout_secs=30.0)
+      else:
+        self._aux_call(front_entries[i - len(serving)],
+                       "configure_broadcast", payload,
                        timeout_secs=30.0)
     if self._tracer is not None:
       self._tracer.event("fleet.broadcast_configured",
-                         hosts=len(serving),
+                         hosts=len(combined),
                          degree=config.broadcast_degree,
                          max_depth=max(depths))
 
@@ -589,6 +658,11 @@ class Fleet:
             if entry["kind"] == "serving"],
         "shards": [entry["address"] for entry in self._aux_hosts
                    if entry["kind"] == "shard"],
+        # Front replicas are NOT act-traffic targets (actors
+        # round-robin over "serving" only); routers read this map.
+        "fronts": {entry["index"]: entry["address"]
+                   for entry in self._aux_hosts
+                   if entry["kind"] == "front"},
     }
     # The control channel rides the DEADLINE half of the envelope
     # only: every control call sits on a latency-bounded path (the
@@ -727,6 +801,47 @@ class Fleet:
         f"actor {index} died ({fault}, {detail}) under "
         f"policy={self.config.actor_crash_policy!r}")
 
+  def _handle_front_failure(self, index: int, fault: str,
+                            **detail: Any) -> None:
+    """One lost front replica: SURVIVABLE membership shrink.
+
+    Fronts only serve — they hold no replay rows, no training lease,
+    and no actor act-traffic — so a death sheds load instead of
+    latching the fleet: routers fail the replica's tenants over to
+    HRW survivors on their side within one client deadline (the
+    placement remap touches ONLY the lost replica's tenants), and
+    the orchestrator prunes the broadcast tree so the next publish
+    fans over the survivors instead of erroring at the dead child.
+    """
+    self._fronts.pop(index, None)
+    name = f"t2r-fleet-front-{index}"
+    self._heartbeats.pop(name, None)
+    self._spawned_at.pop(name, None)
+    entry = next(
+        (e for e in self._aux_hosts
+         if e["kind"] == "front" and e["index"] == index), None)
+    if entry is not None:
+      if entry["client"] is not None:
+        entry["client"].close()
+        entry["client"] = None
+      self._aux_hosts.remove(entry)
+    if self._addresses is not None:
+      self._addresses.get("fronts", {}).pop(index, None)
+    event = {"fault": fault, "target": f"front-{index}",
+             "t_detected": time.monotonic()}
+    event.update(detail)
+    self.front_failures.append(event)
+    if self._tracer is not None:
+      self._tracer.event("fleet.front_replica_lost", **event)
+    log.warning("front replica %d lost (%s %s); %d replica(s) "
+                "remain — routers reshed its tenants to survivors",
+                index, fault, detail, len(self._fronts))
+    try:
+      self._configure_broadcast(self._run_config)
+    except Exception:  # noqa: BLE001 — best-effort rewire
+      log.warning("broadcast rewire after front loss failed",
+                  exc_info=True)
+
   def _check_heartbeats(self) -> None:
     """Hang detection. A stale ACTOR heartbeat is a recoverable fault
     under the restart policy (kill-and-respawn, the `actor_hang`
@@ -744,6 +859,25 @@ class Fleet:
       last = max(value.value, self._spawned_at.get(name, 0.0))
       stale = now - last
       if stale <= timeout:
+        continue
+      if name.startswith("t2r-fleet-front-"):
+        # A hung front replica is handled like a dead one: kill it
+        # and shrink the membership (survivable — see
+        # `_handle_front_failure`).
+        index = int(name.rsplit("-", 1)[1])
+        process = self._fronts.get(index)
+        if process is None:
+          continue
+        log.warning("front %d heartbeat stale for %.0fs; killing the "
+                    "hung replica", index, stale)
+        process.terminate()
+        process.join(timeout=5.0)
+        if process.is_alive():
+          process.kill()
+          process.join(timeout=5.0)
+        self._handle_front_failure(
+            index, faults_lib.SERVING_REPLICA_CRASH,
+            stale_secs=round(stale, 1))
         continue
       if is_actor and self.config.actor_crash_policy == "restart":
         index = int(name.rsplit("-", 1)[1])
@@ -978,6 +1112,13 @@ class Fleet:
         if process.exitcode is not None:
           raise FleetError(
               f"replay shard {index} died (exit {process.exitcode})")
+      # Front replicas are the exception: serving-only, so a death is
+      # a survivable membership shrink, not a fleet error (ISSUE 17).
+      for index, process in list(self._fronts.items()):
+        if process.exitcode is not None:
+          self._handle_front_failure(
+              index, faults_lib.SERVING_REPLICA_CRASH,
+              exitcode=process.exitcode)
       for index, process in list(self._actors.items()):
         if process.exitcode is None:
           continue
@@ -1084,6 +1225,7 @@ class Fleet:
       procs.append(self._host)
     procs.extend(self._serving.values())
     procs.extend(self._shards.values())
+    procs.extend(self._fronts.values())
     return [p for p in procs if p is not None]
 
   def shutdown(self, timeout_secs: float = 60.0,
@@ -1148,6 +1290,7 @@ class Fleet:
       # math is topology-blind.
       replica_metrics: List[Dict[str, Any]] = []
       shard_metrics: List[Dict[str, Any]] = []
+      front_metrics: List[Dict[str, Any]] = []
       for entry in self._aux_hosts:
         try:
           aux = self._aux_call(entry, "metrics", timeout_secs=30.0)
@@ -1157,10 +1300,19 @@ class Fleet:
           continue
         if entry["kind"] == "serving":
           replica_metrics.append(aux)
+        elif entry["kind"] == "front":
+          front_metrics.append(aux)
         else:
           shard_metrics.append(aux)
       metrics = _merge_fleet_metrics(
           metrics, replica_metrics, shard_metrics)
+      if front_metrics:
+        # Front replicas report beside the training-plane merge (the
+        # replica/shard merge math is topology math for the TRAINING
+        # result; fronts are a serving-only tier).
+        metrics["front_hosts"] = front_metrics
+      if self.front_failures:
+        metrics["front_failures"] = list(self.front_failures)
     self._host_stop.set()
     if self._control is not None:
       if self._host is not None and self._host.is_alive():
@@ -1181,6 +1333,9 @@ class Fleet:
     for index, process in self._shards.items():
       self._join_or_kill(process, timeout_secs / 2,
                          f"replay shard {index}")
+    for index, process in self._fronts.items():
+      self._join_or_kill(process, timeout_secs / 2,
+                         f"front host {index}")
     for entry in self._aux_hosts:
       if entry["client"] is not None:
         entry["client"].close()

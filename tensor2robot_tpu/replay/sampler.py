@@ -218,3 +218,54 @@ def concat_shard_major(
     return dict(parts[0])
   return {key: np.concatenate([part[key] for part in parts], axis=0)
           for key in parts[0]}
+
+
+# ---- rendezvous hashing (ISSUE 17: the shared HRW seam) ----
+#
+# The replay plane homes actors on shards with highest-random-weight
+# hashing (`fleet.actor.home_shard`); the replicated serving tier
+# places tenants on front replicas with the SAME rule. These helpers
+# are the canonical form, generalized to an arbitrary bucket-id set so
+# a router can rank over the SURVIVORS after a replica death. The salt
+# is byte-identical to `home_shard`'s (`"{key}|shard-{i}"`), and
+# tests/test_serving_router.py pins `rendezvous_choose(k, range(n)) ==
+# home_shard(k, n)` so the two modules (actor.py must stay jax-free
+# and cannot import this one) can never drift.
+
+
+def rendezvous_weight(key: str, bucket: int) -> int:
+  """The deterministic pseudo-random weight of (key, bucket)."""
+  digest = hashlib.sha256(f"{key}|shard-{bucket}".encode()).digest()
+  return int.from_bytes(digest[:8], "big")
+
+
+def rendezvous_rank(key: str,
+                    buckets: "Iterable[int]") -> "list[int]":
+  """Buckets sorted by descending HRW weight for `key`.
+
+  The operational property (pinned): removing a bucket deletes its
+  entry from every key's ranking and changes NOTHING else — so only
+  keys whose top choice was the removed bucket remap, and each key's
+  fallback order is stable under further membership changes.
+  """
+  members = sorted(set(int(b) for b in buckets))
+  if not members:
+    raise ValueError("rendezvous_rank needs at least one bucket")
+  return sorted(members,
+                key=lambda b: rendezvous_weight(key, b),
+                reverse=True)
+
+
+def rendezvous_choose(key: str, buckets: "Iterable[int]") -> int:
+  """The HRW winner — `home_shard` over an arbitrary bucket set."""
+  return rendezvous_rank(key, buckets)[0]
+
+
+def rendezvous_spread(key: str, buckets: "Iterable[int]",
+                      k: int) -> "list[int]":
+  """The top-`k` buckets for `key` — a hot tenant spread over k
+  replicas. `k` is clamped to the membership size; order is the
+  failover order (index 0 is the HRW home)."""
+  if k < 1:
+    raise ValueError(f"k must be >= 1, got {k}")
+  return rendezvous_rank(key, buckets)[:k]

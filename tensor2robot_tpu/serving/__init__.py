@@ -33,6 +33,22 @@ three more layers over the same engine:
   * `front.ServingFront` — ONE continuous-batching dispatcher over
     every tenant's queue with round-robin fair share, replacing
     per-model micro-batcher loops.
+
+The REPLICATED tier (docs/SERVING.md "Replicated tier", ISSUE 17)
+puts that front on the wire behind `fleet.front.front_main` host
+processes and adds the caller-side composition layers:
+
+  * `router.ServingRouter` — rendezvous-hash tenant placement over N
+    front hosts (the replay plane's HRW rule, shared via
+    `replay.sampler`), hot-tenant spread, and data-path failover:
+    a replica death sheds its tenants to HRW survivors within one
+    client deadline.
+  * `speculative.SpeculativeCEM` — serve the 1-iteration CEM elite
+    inline while the full program refines in the background; refined
+    actions are version-stamped and never cross a param hot-swap.
+  * `dedup.ObservationDedupCache` — quantized-observation hash +
+    param version → cached action; identical frames from robot
+    fleets short-circuit without touching a replica.
 """
 
 from tensor2robot_tpu.serving.bucketing import (
@@ -51,3 +67,12 @@ from tensor2robot_tpu.serving.admission import (
 )
 from tensor2robot_tpu.serving.arena import ModelArena
 from tensor2robot_tpu.serving.front import ServingFront
+from tensor2robot_tpu.serving.dedup import (
+    ObservationDedupCache,
+    observation_key,
+)
+from tensor2robot_tpu.serving.speculative import SpeculativeCEM
+from tensor2robot_tpu.serving.router import (
+    NoReplicasError,
+    ServingRouter,
+)

@@ -1,0 +1,583 @@
+"""Replicated serving tier tests (ISSUE 17): placement, dedup,
+speculative CEM, router failover, the serving_replica_crash fault
+class, and the multi-process front tier.
+
+The pins that keep the tier honest:
+
+  * rendezvous placement is BYTE-IDENTICAL across modules —
+    `replay.sampler.rendezvous_choose` (the router's rule) vs
+    `fleet.actor.home_shard` (the jax-free local copy actors use) —
+    and a membership change remaps ONLY the lost replica's tenants
+    (mirroring the replay-shard pin in test_fleet_cross_host.py);
+  * a dedup hit is bitwise-equal to the uncached path, entries are
+    version-keyed, and a publish invalidates them;
+  * a speculative refinement NEVER crosses a param version swap —
+    version read before dispatch, checked before insert, stamped at
+    serve time;
+  * the router fails over on replica death (TimeoutError/
+    ConnectionError) but NEVER on RpcError (a healthy replica
+    shedding by policy);
+  * `serving_replica_crash` generates only when explicitly requested
+    with `num_fronts`, and the default 7-class plan digest is
+    untouched.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.fleet import faults
+from tensor2robot_tpu.fleet import rpc as rpc_lib
+from tensor2robot_tpu.fleet.actor import home_shard
+from tensor2robot_tpu.replay.sampler import (
+    rendezvous_choose,
+    rendezvous_rank,
+    rendezvous_spread,
+    rendezvous_weight,
+)
+from tensor2robot_tpu.serving.dedup import (
+    ObservationDedupCache,
+    observation_key,
+)
+from tensor2robot_tpu.serving.router import (
+    NoReplicasError,
+    ServingRouter,
+)
+from tensor2robot_tpu.serving.speculative import SpeculativeCEM
+
+KEYS = [f"tenant-{i}" for i in range(200)]
+
+
+class TestRendezvousPlacement:
+
+  def test_byte_parity_with_home_shard(self):
+    # THE cross-module pin: the router's canonical rule and the
+    # actors' jax-free local copy must agree on every key at every
+    # fleet size, or tenants and episodes land on different owners.
+    for n in range(1, 9):
+      for key in KEYS:
+        assert rendezvous_choose(key, range(n)) == home_shard(key, n)
+
+  def test_weight_deterministic_and_bucket_sensitive(self):
+    assert rendezvous_weight("k", 3) == rendezvous_weight("k", 3)
+    weights = {rendezvous_weight("k", b) for b in range(16)}
+    assert len(weights) == 16  # 64-bit digests: collisions ~ never
+
+  def test_rank_is_a_permutation(self):
+    buckets = [5, 2, 9, 0]
+    rank = rendezvous_rank("some-key", buckets)
+    assert sorted(rank) == sorted(buckets)
+    assert rank[0] == rendezvous_choose("some-key", buckets)
+
+  def test_membership_change_remaps_only_lost_bucket(self):
+    # The HRW property the whole tier leans on: when replica `lost`
+    # dies, every tenant homed elsewhere KEEPS its placement (and its
+    # warm arena residency); only the dead replica's tenants move.
+    buckets = list(range(5))
+    before = {k: rendezvous_choose(k, buckets) for k in KEYS}
+    for lost in buckets:
+      survivors = [b for b in buckets if b != lost]
+      moved = 0
+      for key in KEYS:
+        after = rendezvous_choose(key, survivors)
+        if before[key] == lost:
+          moved += 1
+          assert after != lost
+        else:
+          assert after == before[key], (
+              f"{key} moved {before[key]}→{after} though {lost} died")
+      assert moved > 0  # the lost bucket owned SOMETHING
+
+  def test_spread_properties(self):
+    buckets = range(6)
+    spread = rendezvous_spread("hot", buckets, k=3)
+    assert len(spread) == 3
+    assert len(set(spread)) == 3
+    assert spread[0] == rendezvous_choose("hot", buckets)
+    assert spread == rendezvous_rank("hot", buckets)[:3]
+    # k beyond the membership truncates to the full ranking.
+    assert rendezvous_spread("hot", buckets, k=99) == (
+        rendezvous_rank("hot", buckets))
+
+  def test_degenerate_inputs_raise(self):
+    with pytest.raises(ValueError):
+      rendezvous_choose("k", [])
+    with pytest.raises(ValueError):
+      rendezvous_spread("k", [1, 2], k=0)
+
+
+class TestObservationDedupCache:
+
+  def _obs(self, seed, dtype=np.float32):
+    rng = np.random.default_rng(seed)
+    return {"img": rng.random((4, 4)).astype(dtype),
+            "pose": rng.random(3).astype(dtype)}
+
+  def test_hit_is_bitwise_equal_to_uncached_path(self):
+    # The engine is deterministic for identical input+params, so the
+    # cache replays its EXACT output: same object, same bytes.
+    calls = []
+
+    def engine(obs):
+      calls.append(1)
+      return np.asarray([obs["pose"].sum()], np.float64)
+
+    cache = ObservationDedupCache(capacity=8)
+    obs = self._obs(0)
+    key = cache.key(obs)
+    uncached = engine(obs)
+    cache.put(key, 0, uncached)
+    hit = cache.get(key, 0)
+    assert hit is uncached
+    assert hit.tobytes() == engine(obs).tobytes()
+    assert len(calls) == 2  # the hit itself never touched the engine
+
+  def test_get_is_version_keyed(self):
+    cache = ObservationDedupCache(capacity=8)
+    cache.put("k", 3, "action-v3")
+    assert cache.get("k", 3) == "action-v3"
+    assert cache.get("k", 4) is None  # stale stamp = miss
+    assert cache.stats()["misses"] == 1
+
+  def test_invalidate_on_publish(self):
+    cache = ObservationDedupCache(capacity=8)
+    cache.put("old", 1, "a")
+    cache.put("new", 2, "b")
+    assert cache.invalidate(2) == 1  # only the v1 entry dropped
+    assert cache.get("new", 2) == "b"
+    assert cache.get("old", 1) is None
+    assert cache.invalidate(None) == 1  # full clear
+    assert cache.stats()["size"] == 0
+
+  def test_lru_bound_and_eviction(self):
+    cache = ObservationDedupCache(capacity=3)
+    for i in range(5):
+      cache.put(f"k{i}", 0, i)
+    stats = cache.stats()
+    assert stats["size"] == 3
+    assert stats["evictions"] == 2
+    assert cache.get("k0", 0) is None   # oldest evicted
+    assert cache.get("k4", 0) == 4      # newest resident
+
+  def test_quantization_absorbs_float_jitter(self):
+    obs = self._obs(1)
+    jittered = {k: v + 1e-4 for k, v in obs.items()}  # < half a step
+    moved = {k: v + 0.5 for k, v in obs.items()}
+    assert observation_key(obs) == observation_key(jittered)
+    assert observation_key(obs) != observation_key(moved)
+
+  def test_key_covers_names_dtypes_shapes(self):
+    a = {"x": np.zeros(4, np.float32)}
+    assert observation_key(a) != observation_key(
+        {"y": np.zeros(4, np.float32)})
+    assert observation_key(a) != observation_key(
+        {"x": np.zeros(4, np.int32)})
+    assert observation_key(a) != observation_key(
+        {"x": np.zeros((2, 2), np.float32)})
+    assert observation_key(a) == observation_key(dict(a))
+
+
+class _Gate:
+  """A full_predict fake whose dispatch blocks until released."""
+
+  def __init__(self, result):
+    self.release = threading.Event()
+    self.dispatched = threading.Event()
+    self.result = result
+
+  def __call__(self, obs):
+    self.dispatched.set()
+    assert self.release.wait(10.0)
+    return self.result
+
+
+def _wait(predicate, timeout=10.0):
+  deadline = time.monotonic() + timeout
+  while time.monotonic() < deadline:
+    if predicate():
+      return True
+    time.sleep(0.005)
+  return False
+
+
+class TestSpeculativeCEM:
+
+  OBS = {"img": np.ones((2, 2), np.float32)}
+  FAST = np.array([1.0])
+  FULL = np.array([2.0])
+
+  def test_fast_then_refined(self):
+    spec = SpeculativeCEM(
+        fast_predict=lambda obs: self.FAST,
+        full_predict=lambda obs: self.FULL,
+        version_fn=lambda: 0)
+    try:
+      first = spec.predict(self.OBS)
+      assert first is self.FAST
+      assert _wait(lambda: spec.stats()["refines"] >= 1)
+      second = spec.predict(self.OBS)
+      assert second is self.FULL
+      stats = spec.stats()
+      assert stats["fast_served"] == 1
+      assert stats["refined_served"] == 1
+    finally:
+      spec.close()
+
+  def test_refinement_never_crosses_version_swap(self):
+    # THE pin: params swap while the full program runs — the refined
+    # action is stamped with the dead version and must never serve.
+    version = {"v": 0}
+    gate = _Gate(self.FULL)
+    spec = SpeculativeCEM(
+        fast_predict=lambda obs: self.FAST,
+        full_predict=gate,
+        version_fn=lambda: version["v"])
+    try:
+      assert spec.predict(self.OBS) is self.FAST
+      assert gate.dispatched.wait(10.0)  # refinement in flight
+      version["v"] = 1                   # the hot-swap lands
+      gate.release.set()
+      assert _wait(lambda: spec.stats()["refine_discarded"] >= 1)
+      # The repeat must take the fast path again — no stale serve.
+      assert spec.predict(self.OBS) is self.FAST
+      assert spec.stats()["refined_served"] == 0
+      assert spec.stats()["refines"] == 0
+    finally:
+      gate.release.set()
+      spec.close()
+
+  def test_queued_refinement_discarded_on_version_swap(self):
+    # A refinement still WAITING when the swap lands is skipped before
+    # dispatch (its result could only be stale).
+    version = {"v": 0}
+    gate = _Gate(self.FULL)
+    spec = SpeculativeCEM(
+        fast_predict=lambda obs: self.FAST,
+        full_predict=gate,
+        version_fn=lambda: version["v"])
+    try:
+      spec.predict(self.OBS)              # occupies the worker
+      assert gate.dispatched.wait(10.0)
+      other = {"img": np.zeros((2, 2), np.float32)}
+      spec.predict(other)                 # queued behind the gate
+      version["v"] = 1
+      gate.release.set()
+      assert _wait(lambda: spec.stats()["refine_discarded"] >= 2)
+      assert spec.stats()["refines"] == 0
+    finally:
+      gate.release.set()
+      spec.close()
+
+  def test_on_publish_clears_refined_cache(self):
+    version = {"v": 0}
+    spec = SpeculativeCEM(
+        fast_predict=lambda obs: self.FAST,
+        full_predict=lambda obs: self.FULL,
+        version_fn=lambda: version["v"])
+    try:
+      spec.predict(self.OBS)
+      assert _wait(lambda: spec.stats()["refines"] >= 1)
+      assert spec.predict(self.OBS) is self.FULL
+      version["v"] = 1
+      spec.on_publish(1)
+      assert spec.predict(self.OBS) is self.FAST
+    finally:
+      spec.close()
+
+  def test_refine_overflow_drops_without_blocking(self):
+    gate = _Gate(self.FULL)
+    spec = SpeculativeCEM(
+        fast_predict=lambda obs: self.FAST,
+        full_predict=gate,
+        version_fn=lambda: 0,
+        refine_queue=1)
+    try:
+      for i in range(4):
+        obs = {"img": np.full((2, 2), float(i), np.float32)}
+        assert spec.predict(obs) is self.FAST  # hot path never waits
+      assert spec.stats()["refine_dropped"] >= 1
+    finally:
+      gate.release.set()
+      spec.close()
+
+
+class _FakeFront:
+  """A loopback RpcServer speaking the front's predict surface."""
+
+  def __init__(self, index: int):
+    self.index = index
+    self.version = 0
+    self.calls = 0
+    self.reject = False
+    self.server = rpc_lib.RpcServer(self._handle)
+    self.address = self.server.address
+
+  def _handle(self, method, payload, ctx):
+    if method == "predict":
+      self.calls += 1
+      if self.reject:
+        raise ValueError("admission shed")
+      return {"action": np.array([float(self.index)]),
+              "params_version": self.version,
+              "front_index": self.index}
+    if method == rpc_lib.DISCONNECT_METHOD:
+      return None
+    raise ValueError(f"unknown method {method}")
+
+  def close(self):
+    # Don't wait out the 5s join: a thread parked in accept()/recv()
+    # on a closed fd never wakes in-process (production unblocks via
+    # peer disconnect or process exit); the daemon threads are
+    # harmless here and waiting 3x5s per test blows the tier-1
+    # budget.
+    self.server.close(timeout_secs=0.2)
+
+
+@pytest.fixture()
+def fronts():
+  replicas = {i: _FakeFront(i) for i in range(3)}
+  yield replicas
+  for front in replicas.values():
+    front.close()
+
+
+class TestServingRouter:
+
+  OBS = {"img": np.ones((2, 2), np.float32)}
+
+  def _router(self, replicas, **kwargs):
+    return ServingRouter(
+        {i: f.address for i, f in replicas.items()}, **kwargs)
+
+  def test_placement_is_the_hrw_ranking(self, fronts):
+    with self._router(fronts) as router:
+      for tenant in ("a", "b", "hot"):
+        assert router.placement(tenant) == rendezvous_spread(
+            tenant, range(3), k=3)
+
+  def test_predict_routes_to_the_home_replica(self, fronts):
+    with self._router(fronts) as router:
+      for tenant in KEYS[:20]:
+        home = rendezvous_choose(tenant, range(3))
+        action = router.predict(tenant, self.OBS)
+        assert action[0] == float(home)
+
+  def test_rpc_error_never_fails_over(self, fronts):
+    # A healthy replica shedding by policy (RequestRejected et al.)
+    # surfaces to the caller; failing over would stampede the
+    # survivors exactly when one replica asks for backpressure.
+    with self._router(fronts) as router:
+      tenant = next(t for t in KEYS
+                    if rendezvous_choose(t, range(3)) == 1)
+      fronts[1].reject = True
+      calls_elsewhere = fronts[0].calls + fronts[2].calls
+      with pytest.raises(rpc_lib.RpcError):
+        router.predict(tenant, self.OBS)
+      assert sorted(router.alive()) == [0, 1, 2]  # still healthy
+      assert fronts[0].calls + fronts[2].calls == calls_elsewhere
+      assert router.stats()["shed"] == 1
+
+  def test_replica_death_sheds_only_its_tenants(self, fronts):
+    with self._router(fronts) as router:
+      before = {t: router.predict(t, self.OBS)[0] for t in KEYS[:40]}
+      victim = 2
+      fronts[victim].close()
+      after = {}
+      for tenant in KEYS[:40]:
+        after[tenant] = router.predict(tenant, self.OBS)[0]
+      assert victim not in router.alive()
+      assert router.stats()["failovers"] >= 1
+      for tenant in KEYS[:40]:
+        if before[tenant] != float(victim):
+          # The replay-shard pin, at the router: survivors' tenants
+          # never move on another replica's death.
+          assert after[tenant] == before[tenant]
+        else:
+          assert after[tenant] != float(victim)
+          assert after[tenant] == float(rendezvous_choose(
+              tenant, [0, 1]))
+
+  def test_all_dead_raises_no_replicas(self, fronts):
+    with self._router(fronts) as router:
+      for front in fronts.values():
+        front.close()
+      with pytest.raises(NoReplicasError):
+        router.predict("anyone", self.OBS)
+
+  def test_mark_alive_rejoins_placement(self, fronts):
+    with self._router(fronts) as router:
+      router.mark_dead(0)
+      assert router.alive() == [1, 2]
+      router.mark_alive(0)
+      assert router.alive() == [0, 1, 2]
+
+  def test_spread_round_robins_the_hot_tenant(self, fronts):
+    with self._router(fronts, spread=2) as router:
+      targets = {router.predict("hot", self.OBS)[0] for _ in range(8)}
+      expected = set(
+          float(i) for i in rendezvous_spread("hot", range(3), k=2))
+      assert targets == expected
+
+  def test_dedup_short_circuits_repeats(self, fronts):
+    with self._router(fronts, dedup_capacity=16) as router:
+      router.predict("t", self.OBS)
+      served = sum(f.calls for f in fronts.values())
+      for _ in range(5):
+        router.predict("t", self.OBS)
+      assert sum(f.calls for f in fronts.values()) == served
+      assert router.dedup_stats()["hits"] == 5
+
+  def test_dedup_is_tenant_scoped(self, fronts):
+    # Two tenants streaming the SAME frame must NOT share cached
+    # actions — they can be entirely different models. (Found by an
+    # end-to-end drive: a cross-tenant hit short-circuited the
+    # network and hid a replica death from the router.)
+    with self._router(fronts, dedup_capacity=16) as router:
+      router.predict("tenant-a", self.OBS)
+      before = sum(f.calls for f in fronts.values())
+      router.predict("tenant-b", self.OBS)
+      assert sum(f.calls for f in fronts.values()) == before + 1
+      assert router.dedup_stats()["hits"] == 0
+      router.predict("tenant-a", self.OBS)  # same-tenant repeat hits
+      assert router.dedup_stats()["hits"] == 1
+
+  def test_notify_published_invalidates_dedup(self, fronts):
+    with self._router(fronts, dedup_capacity=16) as router:
+      for front in fronts.values():
+        front.version = 0
+      router.predict("t", self.OBS)
+      for front in fronts.values():
+        front.version = 7
+      router.notify_published(7)
+      served = sum(f.calls for f in fronts.values())
+      router.predict("t", self.OBS)  # must re-dispatch: stale entry
+      assert sum(f.calls for f in fronts.values()) == served + 1
+      # ...and the fresh reply re-seeds the cache at the new version.
+      router.predict("t", self.OBS)
+      assert sum(f.calls for f in fronts.values()) == served + 1
+
+
+class TestServingReplicaCrashFaults:
+
+  def test_default_plan_classes_unchanged(self):
+    # The seed-7 digest pin in test_fleet_faults.py depends on the
+    # default class tuple staying the original seven; the new class is
+    # strictly opt-in.
+    assert faults.SERVING_REPLICA_CRASH not in faults.FAULT_CLASSES
+    assert len(faults.FAULT_CLASSES) == 7
+    assert faults.ALL_FAULT_CLASSES == (
+        faults.FAULT_CLASSES + (faults.SERVING_REPLICA_CRASH,))
+
+  def test_generate_requires_num_fronts(self):
+    with pytest.raises(ValueError, match="num_fronts"):
+      faults.FaultPlan.generate(
+          seed=3, num_actors=2,
+          classes=(faults.SERVING_REPLICA_CRASH,))
+
+  def test_generate_targets_a_front(self):
+    plan = faults.FaultPlan.generate(
+        seed=3, num_actors=2,
+        classes=(faults.SERVING_REPLICA_CRASH,), num_fronts=2)
+    assert len(plan.events) == 1
+    event = plan.events[0]
+    assert event.fault == faults.SERVING_REPLICA_CRASH
+    assert event.target in ("front-0", "front-1")
+    assert event.mode == "hard"
+    # Deterministic across calls: the replay pin generalizes.
+    again = faults.FaultPlan.generate(
+        seed=3, num_actors=2,
+        classes=(faults.SERVING_REPLICA_CRASH,), num_fronts=2)
+    assert plan.digest() == again.digest()
+
+  def test_on_serve_seam_fires_once_at_threshold(self):
+    event = faults.FaultEvent(
+        fault=faults.SERVING_REPLICA_CRASH, target="front-0", at=3)
+    plan = faults.FaultPlan(seed=0, events=(event,))
+    injector = faults.FaultInjector(plan, "front-0")
+    assert injector.on_serve(1) is None
+    assert injector.on_serve(2) is None
+    fired = injector.on_serve(3)
+    assert fired is event
+    assert injector.on_serve(4) is None  # one-shot
+    assert injector.injected[0]["fault"] == (
+        faults.SERVING_REPLICA_CRASH)
+
+  def test_on_serve_ignores_other_roles(self):
+    event = faults.FaultEvent(
+        fault=faults.SERVING_REPLICA_CRASH, target="front-1", at=1)
+    plan = faults.FaultPlan(seed=0, events=(event,))
+    injector = faults.FaultInjector(plan, "front-0")
+    assert injector.on_serve(100) is None
+
+
+@pytest.mark.slow
+class TestFrontTierEndToEnd:
+  """The multi-process pin: two REAL front replicas over TCP behind
+  the router — predict for every tenant, one publish fanning out over
+  the broadcast tree to both replicas, and a hard replica kill that
+  the router sheds around without orchestrator help. This is the
+  tier-shaped integration the unit pins above can't see (real
+  sockets, real spawn, real arena swaps)."""
+
+  def test_replicated_tier_end_to_end(self):
+    import jax
+
+    from tensor2robot_tpu.fleet.front import FrontTier
+    from tensor2robot_tpu.fleet.host import _build_learner
+    from tensor2robot_tpu.fleet.orchestrator import FleetConfig
+    from tensor2robot_tpu.specs import make_random_tensors
+
+    config = FleetConfig(
+        num_actors=1, env="mujoco_pose", image_size=16, action_dim=2,
+        torso_filters=(8,), head_filters=(8,), dense_sizes=(16,),
+        cem_population=8, cem_iterations=1, cem_elites=2,
+        serve_max_batch=4, transport="tcp", broadcast_degree=2,
+        front_hosts=2, front_tenants=("a", "b"),
+        launch_timeout_secs=240.0, seed=0)
+    learner = _build_learner(config)
+    state0 = learner.create_state(
+        jax.random.PRNGKey(config.seed), batch_size=2)
+    acting0 = state0.train_state.replace(opt_state=None)
+    obs = make_random_tensors(
+        learner.observation_specification(), batch_size=1, seed=0)
+
+    tier = FrontTier(config, 2).launch()
+    router = ServingRouter(
+        tier.addresses, authkey=config.authkey, transport="tcp")
+    try:
+      # Every tenant gets a real engine answer through the router.
+      for tenant in ("a", "b"):
+        action = np.asarray(router.predict(tenant, obs))
+        assert action.size > 0 and np.all(np.isfinite(action))
+      assert router.params_version == 0
+
+      # ONE publish to the tree root reaches BOTH replicas.
+      assert tier.publish(acting0, step=7) == 7
+      for index in (0, 1):
+        client = tier._client(index)
+        try:
+          scalars = client.call("metrics_scalars", {})
+        finally:
+          if index != 0:
+            client.close()
+        assert scalars["front_publishes"] >= 1.0, (index, scalars)
+      # The router learns the new version from the next reply.
+      router.predict("a", obs)
+      assert router.params_version == 7
+
+      # Kill tenant a's HOME replica: the very next predict fails
+      # over inside the call, and the victim leaves the placement.
+      victim = router.placement("a")[0]
+      tier.kill(victim)
+      action = np.asarray(router.predict("a", obs))
+      assert action.size > 0 and np.all(np.isfinite(action))
+      assert victim not in router.alive()
+      assert victim not in router.placement("a")
+      assert router.stats()["failovers"] >= 1
+    finally:
+      router.close()
+      tier.close()
