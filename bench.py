@@ -120,6 +120,22 @@ Usage: python bench.py [--paper] [--profile DIR] [--input] [--replay]
              gate fails. With --dry-run: tiny fleet, same plan and
              the SAME enforced gates, no BENCH_DETAIL.json write —
              the tier-1 smoke.
+  --control  the closed-loop control-plane axis (control section,
+             ISSUE 18): a live `control.Controller` over a real TCP
+             front tier — offered load ramps past one replica's
+             measured capacity and the controller scales the tier off
+             the breaching p95 through the production actuator
+             adapters (FrontTier.scale_to + router.mark_alive),
+             holding the SLO at a replica-seconds integral gated
+             BELOW the static max-provisioned baseline; plus a chaos
+             leg where a hard-killed front of a real fleet
+             auto-respawns under the front restart budget and rejoins
+             the router via the observer seam with no manual step,
+             and the fleet's own controller must leave no paging
+             alert unremediated. REFUSES to commit (nonzero exit) on
+             any gate. With --dry-run: same legs and the same
+             structural gates at smoke scale, no BENCH_DETAIL.json
+             write — the tier-1 smoke.
   --envs     the on-device vectorized-env axis (envs section):
              env-steps/s of the Anakin rollout engine (envs/ — CEM
              acting at the committed fleet axis's config) vs num_envs
@@ -3748,6 +3764,427 @@ def bench_serving_replicated(dry_run: bool = False):
       tier.close()
 
 
+def bench_control(dry_run: bool = False):
+  """The --control axis (ISSUE 18): the closed-loop control plane
+  driving REAL fleet actuators, with refuse-to-commit gates.
+
+  Two legs, both against real processes:
+
+    * RAMP: a 1-replica front tier over TCP behind the router, with a
+      live `control.Controller` owning the tier through the SAME
+      actuator adapters production uses (`fleet_actuators` over a
+      tier-backed shim — `scale_fronts` calls `FrontTier.scale_to`
+      and rejoins new replicas via `router.mark_alive`). Offered load
+      ramps past one replica's measured capacity; the controller must
+      scale the tier up off the breaching p95 and hold the SLO, while
+      the REPLICA-SECONDS integral stays below the static
+      max-provisioned baseline (the autoscaler's whole argument: SLO
+      of the peak, cost of the trough). The hold-the-SLO gate is
+      core-conditional (two front processes + the driver cannot show
+      added capacity on a small rig — the PR-16 caveat pattern); the
+      scale-up-happened, replica-seconds, decision-record-schema, and
+      NO-PAGE gates are enforced everywhere: a configured remediation
+      (the scale rule) exists for the breaching metric, so ANY page
+      decision refuses the commit.
+    * CHAOS: a tiny REAL fleet (`front_respawn=True`, control plane
+      on) whose front replica is hard-killed mid-run — supervision
+      must detect it, respawn it at its index under the front restart
+      budget, and rejoin it to a live router via the observer seam
+      (`mark_alive`) with NO manual step; the fleet's OWN controller
+      must end with `alert_unhandled == 0` (no page fired where a
+      bound remediation existed).
+
+  `dry_run`: same legs and the SAME enforced gates at smoke scale, no
+  detail-file write — the tier-1 smoke of the control bench path.
+  """
+  import random as _random
+  import threading
+
+  from tensor2robot_tpu.control import (
+    ControlRule,
+    Controller,
+    fleet_actuators,
+  )
+  from tensor2robot_tpu.fleet import FleetConfig
+  from tensor2robot_tpu.fleet import rpc as rpc_lib
+  from tensor2robot_tpu.fleet.front import FrontTier
+  from tensor2robot_tpu.fleet.host import _build_learner
+  from tensor2robot_tpu.fleet.orchestrator import Fleet
+  from tensor2robot_tpu.serving import NoReplicasError, ServingRouter
+  from tensor2robot_tpu.specs import make_random_tensors
+  from tensor2robot_tpu.telemetry import metrics as tmetrics
+  from tensor2robot_tpu.telemetry import records as trecords
+
+  tiny = dry_run
+  cores = os.cpu_count() or 1
+  phase_secs = 1.0 if tiny else 6.0
+  max_fronts = 2
+
+  def _tier_config(num_fronts):
+    return FleetConfig(
+        num_actors=1, env="mujoco_pose", image_size=16, action_dim=2,
+        torso_filters=(8,), head_filters=(8,), dense_sizes=(16,),
+        cem_population=8, cem_iterations=1, cem_elites=2,
+        serve_max_batch=4, transport="tcp", broadcast_degree=2,
+        front_hosts=num_fronts, front_tenants=("policy",),
+        launch_timeout_secs=240.0, seed=0)
+
+  config = _tier_config(1)
+  learner = _build_learner(config)
+  obs1 = make_random_tensors(
+      learner.observation_specification(), batch_size=1, seed=0)
+
+  detail = {
+      "config": ("closed-loop controller over a real TCP front tier "
+                 "(tiny CEM tenant) + a real respawning fleet"),
+      "device_kind": jax.devices()[0].device_kind,
+      "host_cores": cores,
+      "methodology": (
+          "RAMP: open-loop Poisson arrivals ramp past one replica's "
+          "measured capacity; after each phase the measured p95 "
+          "feeds Controller.step() and actuations run through "
+          "fleet_actuators (FrontTier.scale_to + router.mark_alive). "
+          "CHAOS: hard-kill the front of a live fleet with "
+          "front_respawn=True and drive supervision until the "
+          "respawned replica answers through the router again."),
+  }
+
+  # ---- RAMP leg ----
+  tier = FrontTier(config, 1).launch()
+  router = ServingRouter(tier.addresses, authkey=config.authkey,
+                         transport="tcp")
+  pages = []
+
+  class _TierFleet:
+    """The actuator surface over the bench tier: production adapters
+    (`fleet_actuators`) need a fleet-shaped object; here scaling the
+    "fleet" scales the FrontTier and rewires the router — the same
+    respawn/rejoin seam the orchestrator drives in production."""
+
+    num_actors = 1
+
+    @property
+    def num_fronts(self):
+      return len(tier.processes)
+
+    def scale_to(self, num_actors):
+      raise RuntimeError("ramp leg has no actor tier")
+
+    def kick(self, role):
+      raise RuntimeError("ramp leg has no kickable roles")
+
+    def retune_admission(self, tenant, **kw):
+      raise RuntimeError("ramp leg has no admission retune")
+
+    def scale_fronts_to(self, num_fronts):
+      before = set(tier.processes)
+      alive = set(tier.scale_to(num_fronts))
+      for index in sorted(alive - before):
+        router.mark_alive(index, tier.addresses[index])
+      for index in sorted(before - alive):
+        router.mark_dead(index)
+
+  # The bench rule table: scale on breach, page only PAST the scale
+  # rule (so a page always means the remediation failed to hold).
+  def _rules(slo_ms):
+    return [
+        ControlRule(
+            name="ramp_scale_up", metric="serving.policy.request_ms_p95",
+            kind="above", threshold=slo_ms, clear=0.8 * slo_ms,
+            cooldown_secs=0.0, action="scale_fronts",
+            action_params={"delta": 1, "min": 1, "max": max_fronts}),
+        ControlRule(
+            name="ramp_scale_down", metric="serving.policy.request_ms_p95",
+            kind="below", threshold=0.3 * slo_ms, sustain=2,
+            cooldown_secs=0.0, action="scale_fronts",
+            action_params={"delta": -1, "min": 1, "max": max_fronts}),
+        # Escalation past the remediation: TWO consecutive phases deep
+        # past the SLO despite the scale rule above it in the table.
+        # On a capacity-bearing host the scaled tier breaks the streak
+        # — so any page here means the remediation failed to hold.
+        ControlRule(
+            name="ramp_page", metric="serving.policy.request_ms_p95",
+            kind="above", threshold=2.0 * slo_ms, sustain=2,
+            cooldown_secs=0.0, action="page"),
+    ]
+
+  def _open_loop(rate, duration, seed):
+    latencies, errors = [], [0]
+    lock = threading.Lock()
+    rng = _random.Random(seed)
+    arrivals, t = [], rng.expovariate(rate)
+    while t < duration:
+      arrivals.append(t)
+      t += rng.expovariate(rate)
+    cursor = {"i": 0}
+    start = time.perf_counter() + 0.05
+
+    def worker():
+      while True:
+        with lock:
+          i = cursor["i"]
+          if i >= len(arrivals):
+            return
+          cursor["i"] = i + 1
+        due = start + arrivals[i]
+        now = time.perf_counter()
+        if due > now:
+          time.sleep(due - now)
+        try:
+          router.predict("policy", obs1)
+        except (rpc_lib.RpcError, NoReplicasError, TimeoutError,
+                ConnectionError):
+          with lock:
+            errors[0] += 1
+        else:
+          latency = (time.perf_counter() - due) * 1e3
+          with lock:
+            latencies.append(latency)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for thread in threads:
+      thread.start()
+    for thread in threads:
+      thread.join()
+    return latencies, len(arrivals), errors[0]
+
+  try:
+    # Calibrate one replica's capacity through the router. The SLO
+    # comes from the sequential closed-loop p50; the RAMP fractions
+    # must scale the PARALLEL drain capacity — the phases drain with
+    # 4 workers against a batching front (`serve_max_batch`), which
+    # sustains several times the sequential rate, so "1.6x
+    # sequential" is not reliably overload (the flaky-breach bug).
+    for _ in range(3):
+      router.predict("policy", obs1)
+    samples = []
+    for _ in range(5 if tiny else 30):
+      t0 = time.perf_counter()
+      router.predict("policy", obs1)
+      samples.append((time.perf_counter() - t0) * 1e3)
+    p50_1 = float(np.percentile(samples, 50))
+    slo_ms = max(20.0, 5.0 * p50_1)
+    burst_secs = 0.5 if tiny else 2.0
+    counts = [0, 0, 0, 0]
+    burst_stop = time.perf_counter() + burst_secs
+
+    def _burst(slot):
+      while time.perf_counter() < burst_stop:
+        router.predict("policy", obs1)
+        counts[slot] += 1
+
+    burst_threads = [threading.Thread(target=_burst, args=(slot,))
+                     for slot in range(4)]
+    t0 = time.perf_counter()
+    for thread in burst_threads:
+      thread.start()
+    for thread in burst_threads:
+      thread.join()
+    cap_rps = max(1.0, sum(counts) / (time.perf_counter() - t0))
+    detail["calibration"] = {
+        "closed_loop_p50_ms": round(p50_1, 2),
+        "sequential_rps": round(1e3 / p50_1, 1),
+        "parallel_capacity_rps": round(cap_rps, 1),
+        "slo_ms": round(slo_ms, 1),
+    }
+
+    controller = Controller(
+        _rules(slo_ms),
+        fleet_actuators(_TierFleet(), on_page=pages.append),
+        max_actions=8, budget_window_secs=0.0,
+        registry=tmetrics.MetricsRegistry())
+    ramp = []
+    replica_seconds = 0.0
+    # The ramp: under / at / past one replica's capacity. The
+    # controller reads each phase's measured p95 (the same
+    # serving.<tenant>.request_ms_p95 scalar production aggregates)
+    # and scales BETWEEN phases.
+    for frac in (0.3, 0.8, 1.6, 1.6):
+      rate = max(1.0, frac * cap_rps)
+      fronts_before = len(tier.processes)
+      latencies, offered, errors = _open_loop(
+          rate, phase_secs, seed=int(frac * 10))
+      replica_seconds += fronts_before * phase_secs
+      # A starved phase reads as a finite worst-case (envelope
+      # payloads must stay finite for validate_record).
+      p95 = (float(np.percentile(latencies, 95))
+             if latencies else 60_000.0)
+      p99 = (float(np.percentile(latencies, 99))
+             if latencies else 60_000.0)
+      decisions = controller.step(
+          {"serving.policy.request_ms_p95": p95})
+      ramp.append({
+          "offered_fraction_of_capacity": frac,
+          "offered_rps": round(offered / phase_secs, 1),
+          "fronts_during_phase": fronts_before,
+          "fronts_after_decision": len(tier.processes),
+          "p95_ms": round(p95, 2), "p99_ms": round(p99, 2),
+          "errors": errors,
+          "decisions": [
+              {"rule": d["rule"], "outcome": d["outcome"]}
+              for d in decisions],
+      })
+    static_replica_seconds = max_fronts * phase_secs * len(ramp)
+    scale_ups = [d for d in controller.decisions
+                 if d["rule"] == "ramp_scale_up"
+                 and d["outcome"] == "actuated"]
+    # Every decision the ramp produced must be a schema-valid
+    # telemetry envelope — the decision log reads with the same
+    # tooling as every other metrics file.
+    for decision in controller.decisions:
+      trecords.validate_record(Controller.decision_record(decision))
+    slo_held = ramp[-1]["p95_ms"] <= slo_ms
+    slo_gate_enforced = (not tiny) and cores >= 4
+    detail["ramp"] = {
+        "phases": ramp,
+        "scale_up_actuations": len(scale_ups),
+        "pages": len(pages),
+        "replica_seconds": round(replica_seconds, 1),
+        "static_max_provisioned_replica_seconds": round(
+            static_replica_seconds, 1),
+        "replica_seconds_saved_fraction": round(
+            1.0 - replica_seconds / static_replica_seconds, 3),
+        "final_phase_p95_ms": ramp[-1]["p95_ms"],
+        "slo_ms": round(slo_ms, 1),
+        "slo_held": slo_held,
+        "slo_gate_enforced": slo_gate_enforced,
+        "slo_note": (
+            "gate enforced" if slo_gate_enforced else
+            f"hold-the-SLO gate unverifiable on this {cores}-core "
+            "host (a second front process adds no parallel capacity "
+            "under the driver); measured p95 recorded"),
+        "controller": controller.stats(),
+    }
+    if not scale_ups:
+      raise SystemExit(
+          "control gate FAILED: the ramp breached the SLO but the "
+          "controller never actuated a scale-up "
+          f"(decisions={[dict(d) for d in controller.decisions]}); "
+          "refusing to commit.")
+    # The no-page gate rides the same core condition as the SLO hold:
+    # on a small rig the scale remediation exists but cannot add
+    # capacity, so a sustained overload page there is CORRECT
+    # controller behavior, not a bench failure.
+    if slo_gate_enforced and pages:
+      raise SystemExit(
+          f"control gate FAILED: the controller paged {len(pages)} "
+          "time(s) although a configured remediation (the scale "
+          "rule) exists for the breaching metric; refusing to "
+          "commit.")
+    if replica_seconds >= static_replica_seconds:
+      raise SystemExit(
+          "control gate FAILED: the controlled ramp consumed "
+          f"{replica_seconds:.1f} replica-seconds, not below the "
+          f"static max-provisioned {static_replica_seconds:.1f}; "
+          "refusing to commit.")
+    if slo_gate_enforced and not slo_held:
+      raise SystemExit(
+          f"control gate FAILED: final ramped phase p95 "
+          f"{ramp[-1]['p95_ms']:.1f}ms > SLO {slo_ms:.1f}ms with "
+          "the scaled tier; refusing to commit.")
+  finally:
+    try:
+      router.close()
+    finally:
+      tier.close()
+
+  # ---- CHAOS leg: kill a front under a live fleet ----
+  import tempfile
+  chaos_dir = tempfile.mkdtemp(prefix="t2r_control_chaos_")
+  fleet_config = FleetConfig(
+      num_actors=1, env="mujoco_pose", image_size=16, action_dim=2,
+      torso_filters=(8,), head_filters=(8,), dense_sizes=(16,),
+      cem_population=8, cem_iterations=1, cem_elites=2,
+      batch_size=8, batch_episodes=2, max_train_steps=2000,
+      publish_every_steps=1000, serve_max_batch=4,
+      transport="tcp", front_hosts=1, front_tenants=("policy",),
+      front_respawn=True, max_front_restarts=2,
+      control=True, control_budget_window_secs=0.0,
+      telemetry_poll_secs=0.5,
+      launch_timeout_secs=240.0, run_timeout_secs=900.0, seed=0)
+  fleet = Fleet(fleet_config, chaos_dir)
+  events = []
+  fleet.launch()
+  try:
+    chaos_router = ServingRouter(
+        dict(fleet._addresses["fronts"]), authkey=fleet_config.authkey,
+        transport="tcp")
+    try:
+      def observer(event, index, address):
+        events.append((event, index))
+        if event in ("respawned", "added"):
+          chaos_router.mark_alive(index, address)
+        else:
+          chaos_router.mark_dead(index)
+      fleet.add_front_observer(observer)
+      assert np.asarray(
+          chaos_router.predict("policy", obs1)).size > 0
+      victim = chaos_router.placement("policy")[0]
+      fleet._fronts[victim].kill()
+      t_kill = time.perf_counter()
+      deadline = time.monotonic() + 300.0
+      while time.monotonic() < deadline:
+        fleet._supervise_once()
+        if any(r["target"] == f"front-{victim}"
+               for r in fleet.recoveries):
+          break
+        time.sleep(0.2)
+      recovered = [r for r in fleet.recoveries
+                   if r["target"] == f"front-{victim}"]
+      respawn_wall_ms = (time.perf_counter() - t_kill) * 1e3
+      served_after = bool(
+          recovered
+          and np.asarray(chaos_router.predict("policy", obs1)).size)
+      detail["chaos"] = {
+          "victim": victim,
+          "recovered": bool(recovered),
+          "mttr_ms": recovered[0]["mttr_ms"] if recovered else None,
+          "respawn_wall_ms": round(respawn_wall_ms, 1),
+          "observer_events": events,
+          "router_rejoined": victim in chaos_router.alive(),
+          "served_after_respawn": served_after,
+          "front_failures": len(fleet.front_failures),
+      }
+      if not recovered or not served_after:
+        raise SystemExit(
+            "control gate FAILED: the killed front replica was not "
+            f"auto-respawned and re-served (events={events}, "
+            f"recoveries={fleet.recoveries}); refusing to commit.")
+      if ("respawned", victim) not in events or fleet.front_failures:
+        raise SystemExit(
+            "control gate FAILED: recovery happened but not through "
+            "the respawn+mark_alive seam (events="
+            f"{events}, front_failures={fleet.front_failures}); "
+            "refusing to commit.")
+    finally:
+      chaos_router.close()
+  finally:
+    metrics = fleet.shutdown() or {}
+    controller_stats = metrics.get("control")
+  detail["chaos"]["fleet_controller"] = controller_stats
+  # The no-page gate on the REAL fleet's own controller: every alert
+  # with a bound remediation must have been handled (a page where a
+  # configured remediation exists refuses the commit).
+  if controller_stats and controller_stats.get("alert_unhandled"):
+    raise SystemExit(
+        "control gate FAILED: the fleet controller left "
+        f"{controller_stats['alert_unhandled']} paging alert(s) "
+        "unremediated although a bound remediation rule exists; "
+        "refusing to commit.")
+
+  detail["conclusion"] = (
+      f"closed loop held: the ramp scaled 1→"
+      f"{max(r['fronts_after_decision'] for r in ramp)} fronts off "
+      f"the breaching p95 ({len(scale_ups)} scale-up actuation(s), "
+      f"0 pages) at {detail['ramp']['replica_seconds']:.0f} "
+      "replica-seconds vs the static max-provisioned "
+      f"{detail['ramp']['static_max_provisioned_replica_seconds']:.0f}"
+      f" ({detail['ramp']['slo_note']}); the killed front respawned "
+      f"in {detail['chaos']['respawn_wall_ms']:.0f}ms wall and "
+      "rejoined the router via mark_alive with no manual step.")
+  return detail
+
+
 def _bench_savedmodel_host_latency(calls: int = 100):
   """serving_default latency of the exported policy net on host CPU.
 
@@ -4117,6 +4554,30 @@ def main():
             smoke["sentinel"]["page_flight_records"],
     }))
     return
+  if "--control" in args and "--dry-run" in args:
+    # Tier-1 smoke of the control plane: the RAMP leg (real TCP front
+    # tier, live Controller scaling through fleet_actuators) and the
+    # CHAOS leg (real fleet, front hard-killed → auto-respawned →
+    # rejoined via mark_alive) with the structural gates ENFORCED
+    # (scale-up actuated, replica-seconds below static provisioning,
+    # schema-valid decision records, no unremediated paging alert on
+    # the fleet's controller) — NO detail-file write.
+    smoke = bench_control(dry_run=True)
+    print(json.dumps({
+        "control_dry_run": "ok",
+        "scale_up_actuations": smoke["ramp"]["scale_up_actuations"],
+        "pages": smoke["ramp"]["pages"],
+        "replica_seconds": smoke["ramp"]["replica_seconds"],
+        "static_max_provisioned_replica_seconds":
+            smoke["ramp"]["static_max_provisioned_replica_seconds"],
+        "final_phase_p95_ms": smoke["ramp"]["final_phase_p95_ms"],
+        "slo_gate_enforced": smoke["ramp"]["slo_gate_enforced"],
+        "chaos_recovered": smoke["chaos"]["recovered"],
+        "chaos_mttr_ms": smoke["chaos"]["mttr_ms"],
+        "chaos_router_rejoined": smoke["chaos"]["router_rejoined"],
+        "chaos_front_failures": smoke["chaos"]["front_failures"],
+    }))
+    return
   if "--serving" in args and "--dry-run" in args:
     # Tier-1 smoke of the serving bench path: tiny model, one small
     # bucket table, local backend, NO detail-file write (a CPU smoke
@@ -4197,7 +4658,8 @@ def main():
   axis_flags = {"--input", "--replay", "--replayfeed", "--longcontext",
                 "--podscale", "--moe", "--pipeline", "--verify",
                 "--serving", "--coldstart", "--mxu", "--mfu",
-                "--fleet", "--envs", "--telemetry", "--chaos"}
+                "--fleet", "--envs", "--telemetry", "--chaos",
+                "--control"}
   axis_only = (bool(args) and not run_paper and profile_dir is None
                and "--primary" not in args
                and all(a in axis_flags for a in args))
@@ -4294,6 +4756,13 @@ def main():
     detail["serving_replicated"] = bench_serving_replicated()
   if "--fleet" in args:
     detail["fleet"] = bench_fleet()
+  if "--control" in args:
+    # The closed-loop control plane (ISSUE 18): the controller holds
+    # the serving SLO under a ramping load by scaling real front
+    # replicas (replica-seconds gated below static max-provisioning)
+    # and a killed front auto-respawns + rejoins the router — each
+    # with its refuse-to-commit gate.
+    detail["control"] = bench_control()
   if "--chaos" in args:
     section = bench_chaos()
     # Env-steps lost: the chaos run's settled/median collection rate

@@ -90,6 +90,17 @@ echo "--- chaos smoke (bench.py --chaos --dry-run; recovery gates) ---"
 env JAX_PLATFORMS=cpu python bench.py --chaos --dry-run
 chaos_rc=$?
 
+# The control smoke is the ISSUE-18 closed-loop gate: a live
+# Controller over a real TCP front tier must actuate a scale-up off a
+# breaching p95 through the production actuator adapters at a
+# replica-seconds integral below static max-provisioning, every
+# decision record must validate against the envelope schema, and a
+# hard-killed front of a real fleet must auto-respawn and rejoin the
+# router via mark_alive with no manual step and no unremediated page.
+echo "--- control smoke (bench.py --control --dry-run; closed-loop gates) ---"
+env JAX_PLATFORMS=cpu python bench.py --control --dry-run
+control_rc=$?
+
 if [ "$rc" -ne 0 ]; then exit "$rc"; fi
 if [ "$smoke_rc" -ne 0 ]; then exit "$smoke_rc"; fi
 if [ "$coldstart_rc" -ne 0 ]; then exit "$coldstart_rc"; fi
@@ -100,4 +111,5 @@ if [ "$fleet_rc" -ne 0 ]; then exit "$fleet_rc"; fi
 if [ "$envs_rc" -ne 0 ]; then exit "$envs_rc"; fi
 if [ "$telemetry_rc" -ne 0 ]; then exit "$telemetry_rc"; fi
 if [ "$report_rc" -ne 0 ]; then exit "$report_rc"; fi
-exit "$chaos_rc"
+if [ "$chaos_rc" -ne 0 ]; then exit "$chaos_rc"; fi
+exit "$control_rc"

@@ -49,6 +49,7 @@ _CONCURRENCY_PATHS = (
     "tensor2robot_tpu/fleet",
     "tensor2robot_tpu/envs",
     "tensor2robot_tpu/telemetry",
+    "tensor2robot_tpu/control",
 )
 _GIN_PATHS = ("tensor2robot_tpu",)
 # obs (OBS501, ISSUE 15) scans the package's literal metric names

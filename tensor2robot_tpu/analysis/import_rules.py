@@ -52,6 +52,12 @@ WORKER_SAFE_MODULES = (
     # jax-free (the dynamic twin is tests/test_telemetry.py's
     # subprocess import pin).
     "tensor2robot_tpu.telemetry",
+    # ISSUE 18: the control plane runs in the supervising process
+    # beside the orchestrator's poll loop — a policy plane that drags
+    # an XLA runtime in would cost more than the regressions it
+    # remediates (dynamic twin: tests/test_control.py's subprocess
+    # import pin).
+    "tensor2robot_tpu.control",
 )
 
 BANNED_IMPORTS = ("jax", "tensorflow")

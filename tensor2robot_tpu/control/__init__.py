@@ -1,0 +1,63 @@
+"""Closed-loop control plane: the policy layer over telemetry and
+actuators (ISSUE 18, docs/CONTROL.md).
+
+The fleet could already DETECT (the alert sentinel, ISSUE 15) and
+ACT (elastic scaling, kill-and-respawn, admission retuning — ISSUEs
+13/14/17) — this package closes the loop between them:
+
+  * `rules` — the `ControlRule` grammar: condition over metric
+    windows → action, with hysteresis bands, per-rule cooldowns, and
+    sustained-breach semantics;
+  * `controller` — the `Controller` loop: ordered rule evaluation
+    over the orchestrator's aggregated scalar view, a global
+    rate-based actuation budget, dry-run mode, and full decision
+    observability (envelope records, `control.*` counters,
+    flight-record integration);
+  * `actuators` — the lever catalog over already-shipped seams
+    (`Fleet.scale_to`, front scale/respawn, admission retune, the
+    degradation ladder, page-as-fallback);
+  * `policies` — the standing gin-tunable fleet rule table
+    (`qtopt_fleet_autopilot.gin` binds it).
+
+The whole package is jax-free BY CONTRACT (IMP401 worker-safe set;
+subprocess-pinned by tests/test_control.py): a policy plane that
+drags an XLA runtime into the supervising process would cost more
+than the regressions it remediates.
+"""
+
+from tensor2robot_tpu.control import actuators
+from tensor2robot_tpu.control import controller
+from tensor2robot_tpu.control import policies
+from tensor2robot_tpu.control import rules
+from tensor2robot_tpu.control.actuators import (
+    ActuationError,
+    Actuator,
+    DegradationLadder,
+    fleet_actuators,
+)
+from tensor2robot_tpu.control.controller import (
+    DECISIONS_FILENAME,
+    OUTCOMES,
+    Controller,
+    read_decisions,
+)
+from tensor2robot_tpu.control.policies import fleet_rules
+from tensor2robot_tpu.control.rules import ControlRule, RuleState
+
+__all__ = [
+    "ActuationError",
+    "Actuator",
+    "ControlRule",
+    "Controller",
+    "DECISIONS_FILENAME",
+    "DegradationLadder",
+    "OUTCOMES",
+    "RuleState",
+    "actuators",
+    "controller",
+    "fleet_actuators",
+    "fleet_rules",
+    "policies",
+    "read_decisions",
+    "rules",
+]

@@ -262,6 +262,24 @@ class _FrontState:
       return {"host": tmetrics.registry().snapshot(),
               "pushed": {},
               "monotonic": time.monotonic()}
+    if method == "slo_report":
+      # The control plane's SLO scorecard pull (ISSUE 18): per-tenant
+      # dispatch + e2e views off this replica's own histograms.
+      return self.front.admission.slo_report()
+    if method == "admission_retune":
+      # The `retune_admission` actuator lands here; kwargs pass
+      # through to `AdmissionController.retune` (absolute rate OR
+      # factor, clamped). Unknown tenants raise — the RPC error
+      # surfaces in the controller's decision record.
+      kwargs = {k: payload[k]
+                for k in ("rate_rps", "factor", "burst",
+                          "min_rate_rps", "max_rate_rps")
+                if k in payload}
+      policy = self.front.admission.retune(str(payload["tenant"]),
+                                           **kwargs)
+      return {"tenant": str(payload["tenant"]),
+              "rate_rps": policy.rate_rps,
+              "burst": policy.burst}
     if method == "flight_record":
       return flightrec.dump(payload["out_dir"],
                             payload.get("reason", "requested"))
@@ -342,38 +360,112 @@ class FrontTier:
     self._root_client: Optional[rpc_lib.RpcClient] = None
 
   def launch(self, timeout_secs: float = 240.0) -> "FrontTier":
-    pending = []
-    for i in range(self._num):
-      parent_conn, child_conn = self._ctx.Pipe()
-      heartbeat = self._ctx.Value("d", time.monotonic())
-      process = self._ctx.Process(
-          target=front_main,
-          args=(self._config, i, None, child_conn, self._stop,
-                heartbeat),
-          name=f"t2r-front-{i}", daemon=True)
-      process.start()
-      child_conn.close()
-      self.processes[i] = process
-      self._heartbeats[i] = heartbeat
-      pending.append((i, parent_conn, process))
+    pending = [self._start_front(i) for i in range(self._num)]
     deadline = time.monotonic() + timeout_secs
     for i, parent_conn, process in pending:
       remaining = max(0.0, deadline - time.monotonic())
-      if not parent_conn.poll(remaining):
-        raise RuntimeError(
-            f"front {i} did not report ready within "
-            f"{timeout_secs:.0f}s (exitcode={process.exitcode})")
-      try:
-        info = parent_conn.recv()
-      except (EOFError, OSError):
-        process.join(timeout=10.0)
-        raise RuntimeError(
-            f"front {i} died before reporting ready "
-            f"(exitcode={process.exitcode})") from None
-      parent_conn.close()
-      self.addresses[i] = tuple(info["address"])
+      self._await_front(i, parent_conn, process, remaining,
+                        timeout_secs)
     self._configure_broadcast()
     return self
+
+  def _start_front(self, index: int):
+    """Forks one front replica; returns the pending ready handshake."""
+    parent_conn, child_conn = self._ctx.Pipe()
+    heartbeat = self._ctx.Value("d", time.monotonic())
+    process = self._ctx.Process(
+        target=front_main,
+        args=(self._config, index, None, child_conn, self._stop,
+              heartbeat),
+        name=f"t2r-front-{index}", daemon=True)
+    process.start()
+    child_conn.close()
+    self.processes[index] = process
+    self._heartbeats[index] = heartbeat
+    return index, parent_conn, process
+
+  def _await_front(self, index: int, parent_conn, process,
+                   remaining: float, timeout_secs: float) -> None:
+    if not parent_conn.poll(max(0.0, remaining)):
+      raise RuntimeError(
+          f"front {index} did not report ready within "
+          f"{timeout_secs:.0f}s (exitcode={process.exitcode})")
+    try:
+      info = parent_conn.recv()
+    except (EOFError, OSError):
+      process.join(timeout=10.0)
+      raise RuntimeError(
+          f"front {index} died before reporting ready "
+          f"(exitcode={process.exitcode})") from None
+    parent_conn.close()
+    self.addresses[index] = tuple(info["address"])
+
+  # ---- elastic surface (the control plane's front levers) ----
+
+  def scale_to(self, num_fronts: int,
+               timeout_secs: float = 240.0) -> List[int]:
+    """Grows/shrinks the live tier to `num_fronts` replicas (ISSUE 18
+    — the standalone `scale_fronts` actuator for bench legs; inside a
+    full fleet the orchestrator's `scale_fronts_to` owns this).
+
+    Growth spawns at fresh indices past the highest ever used; shrink
+    drains the HIGHEST-indexed live replicas via the RPC `shutdown`
+    (front 0, the broadcast root, is never shed). Dead replicas are
+    pruned from the address book and the publish tree is rewired over
+    the survivors. Returns the live index list."""
+    if num_fronts < 1:
+      raise ValueError(f"num_fronts must be >= 1, got {num_fronts}")
+    self._prune_dead()
+    live = self.alive()
+    if len(live) < num_fronts:
+      base = max(self.processes, default=-1) + 1
+      pending = [self._start_front(base + k)
+                 for k in range(num_fronts - len(live))]
+      deadline = time.monotonic() + timeout_secs
+      for i, parent_conn, process in pending:
+        self._await_front(i, parent_conn, process,
+                          deadline - time.monotonic(), timeout_secs)
+    elif len(live) > num_fronts:
+      for index in sorted(live, reverse=True)[:len(live) - num_fronts]:
+        client = self._client(index)
+        try:
+          client.call("shutdown", {})
+        finally:
+          if index != 0:
+            client.close()
+        self.processes[index].join(timeout=timeout_secs)
+        self._forget(index)
+    self._configure_broadcast()
+    return self.alive()
+
+  def respawn(self, index: int, timeout_secs: float = 240.0
+              ) -> Tuple[str, int]:
+    """Respawns a DEAD replica at its original index and rewires the
+    tree; returns the new address (the caller re-routes via the
+    router's `mark_alive`). Raises if the old process still runs —
+    respawn is recovery, not restart."""
+    process = self.processes.get(index)
+    if process is not None and process.exitcode is None:
+      raise RuntimeError(f"front {index} is still alive")
+    self._forget(index)
+    i, parent_conn, new_process = self._start_front(index)
+    self._await_front(i, parent_conn, new_process, timeout_secs,
+                      timeout_secs)
+    self._configure_broadcast()
+    return self.addresses[index]
+
+  def _forget(self, index: int) -> None:
+    self.processes.pop(index, None)
+    self.addresses.pop(index, None)
+    self._heartbeats.pop(index, None)
+    if index == 0 and self._root_client is not None:
+      self._root_client.close()
+      self._root_client = None
+
+  def _prune_dead(self) -> None:
+    for index, process in list(self.processes.items()):
+      if process.exitcode is not None:
+        self._forget(index)
 
   def _configure_broadcast(self) -> None:
     from tensor2robot_tpu.fleet.orchestrator import (

@@ -45,14 +45,16 @@ import json
 import logging
 import multiprocessing as mp
 import os
+import re
 import secrets
 import subprocess
 import sys
 import threading
 import time
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from tensor2robot_tpu import config as gin
+from tensor2robot_tpu import control as control_lib
 from tensor2robot_tpu.fleet import actor as actor_lib
 from tensor2robot_tpu.fleet import faults as faults_lib
 from tensor2robot_tpu.fleet import front as front_lib
@@ -222,6 +224,36 @@ class FleetConfig:
   # offending role, exactly like the hang path. Needs the telemetry
   # plane (poll cadence > 0).
   sentinel: bool = True
+  # Closed-loop control plane (ISSUE 18, docs/CONTROL.md): when on,
+  # a jax-free `control.Controller` evaluates the gin-tunable rule
+  # table (`control.policies.fleet_rules`) over every aggregated
+  # telemetry poll and drives the fleet's own levers — actor/front
+  # scaling, targeted kill-and-respawn, admission retunes, the
+  # degradation ladder — under a global rate-based actuation budget.
+  # `control_dry_run` evaluates + records would-act decisions without
+  # touching an actuator (the rollout workflow). Paging stays the
+  # FALLBACK tier: the sentinel's `on_act` hook routes page-severity
+  # alerts through the controller first, and only an unremediated
+  # breach pages.
+  control: bool = False
+  control_dry_run: bool = False
+  control_cadence_secs: float = 0.0  # 0 = every telemetry poll
+  control_max_actions: int = 4
+  control_budget_window_secs: float = 300.0
+  # Graceful degradation: tenants in SHED ORDER (lowest priority
+  # first); the `shed_tenant` actuator clamps the next one's admission
+  # rate to `control_shed_rate_rps` ("serve the flagship slowly
+  # rather than everyone badly"), `restore_tenants` undoes all sheds.
+  control_shed_priorities: Tuple[str, ...] = ()
+  control_shed_rate_rps: float = 1.0
+  # Front replica recovery (ISSUE 18): a lost front replica is
+  # RESPAWNED at its index under its own rate budget
+  # (`max_front_restarts` per `restart_window_secs`), rejoining the
+  # broadcast tree and — via the front observer seam — the routers
+  # (`ServingRouter.mark_alive`). Budget exhausted or respawn off:
+  # the ISSUE-17 survivable membership shrink, unchanged.
+  front_respawn: bool = True
+  max_front_restarts: int = 2
   # Fault injection (tests / bench failure-path rehearsal). The
   # legacy single-fault knobs remain; `fault_plan` is the ISSUE-14
   # deterministic schedule (faults.FaultPlan — picklable, shipped to
@@ -294,6 +326,22 @@ class FleetConfig:
     if self.dedup_capacity < 0:
       raise ValueError(
           f"dedup_capacity must be >= 0, got {self.dedup_capacity}")
+    if self.max_front_restarts < 0:
+      raise ValueError(
+          f"max_front_restarts must be >= 0, got "
+          f"{self.max_front_restarts}")
+    if self.control_max_actions < 1:
+      raise ValueError(
+          f"control_max_actions must be >= 1, got "
+          f"{self.control_max_actions}")
+    if self.control_cadence_secs < 0 or self.control_budget_window_secs < 0:
+      raise ValueError(
+          "control_cadence_secs and control_budget_window_secs must "
+          "be >= 0")
+    if self.control_shed_rate_rps <= 0:
+      raise ValueError(
+          f"control_shed_rate_rps must be positive, got "
+          f"{self.control_shed_rate_rps}")
     if self.fault_plan is not None and not isinstance(
         self.fault_plan, faults_lib.FaultPlan):
       raise ValueError(
@@ -393,6 +441,17 @@ class Fleet:
     self._telemetry_file: Optional[Any] = None
     self._t_last_poll = 0.0
     self._sentinel: Optional[sentinel_lib.Sentinel] = None
+    # Closed-loop control plane (ISSUE 18): built at launch when
+    # `config.control` is on; stepped after every telemetry poll.
+    self._controller: Optional[control_lib.Controller] = None
+    self._degradation: Optional[control_lib.DegradationLadder] = None
+    # Front membership callbacks `(event, index, address)` with event
+    # in {"respawned", "lost", "added", "removed"} — a ServingRouter
+    # owner calls `mark_alive`/`mark_dead` from them so a respawned
+    # replica rejoins placement with NO manual step.
+    self._front_observers: List[Callable[[str, int, Any], None]] = []
+    self._front_restarts: Dict[int, int] = {}
+    self._next_front_index = config.front_hosts
 
   # ---- launch ----
 
@@ -501,25 +560,31 @@ class Fleet:
       self._aux_hosts.append(entry)
       pending.append((entry, parent_conn, process, f"replay shard {i}"))
     for i in range(getattr(config, "front_hosts", 0)):
-      name = f"t2r-fleet-front-{i}"
-      parent_conn, child_conn = self._ctx.Pipe()
-      process = self._ctx.Process(
-          target=front_lib.front_main,
-          args=(config, i, self._address, child_conn, self._host_stop,
-                self._heartbeat(name)),
-          name=name, daemon=True)
-      process.start()
-      child_conn.close()
-      self._fronts[i] = process
-      entry = {"kind": "front", "index": i, "name": f"front{i}",
-               "address": None, "client": None}
-      self._aux_hosts.append(entry)
-      pending.append((entry, parent_conn, process, f"front host {i}"))
+      pending.append(self._spawn_front(config, i))
     deadline = time.monotonic() + config.launch_timeout_secs
     for entry, parent_conn, process, what in pending:
       remaining = max(0.0, deadline - time.monotonic())
       entry["address"] = self._await_ready(
           parent_conn, process, what, remaining)
+
+  def _spawn_front(self, config: FleetConfig, index: int):
+    """Forks one front replica and registers its bookkeeping; returns
+    the `(entry, parent_conn, process, what)` pending-handshake tuple
+    (launch, respawn, and front scale-up all await it the same way)."""
+    name = f"t2r-fleet-front-{index}"
+    parent_conn, child_conn = self._ctx.Pipe()
+    process = self._ctx.Process(
+        target=front_lib.front_main,
+        args=(config, index, self._address, child_conn,
+              self._host_stop, self._heartbeat(name)),
+        name=name, daemon=True)
+    process.start()
+    child_conn.close()
+    self._fronts[index] = process
+    entry = {"kind": "front", "index": index, "name": f"front{index}",
+             "address": None, "client": None}
+    self._aux_hosts.append(entry)
+    return entry, parent_conn, process, f"front host {index}"
 
   def _aux_client(self, entry: Dict[str, Any]) -> Optional[RpcClient]:
     """The entry's control client, (re)connected on demand. Same
@@ -618,15 +683,43 @@ class Fleet:
       # or a test with its own telemetry identity).
       self._tracer = tcore.Tracer().configure(
           "orchestrator", trace_dir=config.telemetry_dir)
+    if (config.control and config.telemetry_dir
+        and config.telemetry_poll_secs):
+      # The closed-loop control plane (ISSUE 18): the gin-tunable
+      # rule table over the standard actuator set, stepped after
+      # every aggregated poll. Built BEFORE the sentinel so the
+      # sentinel's act tier can route alerts through it.
+      if config.control_shed_priorities:
+        self._degradation = control_lib.DegradationLadder(
+            config.control_shed_priorities,
+            retune=self._shed_retune,
+            shed_rate_rps=config.control_shed_rate_rps)
+      self._controller = control_lib.Controller(
+          control_lib.fleet_rules(),
+          control_lib.fleet_actuators(
+              self, on_page=self._control_page,
+              degradation=self._degradation),
+          cadence_secs=config.control_cadence_secs,
+          dry_run=config.control_dry_run,
+          max_actions=config.control_max_actions,
+          budget_window_secs=config.control_budget_window_secs,
+          decisions_path=os.path.join(
+              config.telemetry_dir, control_lib.DECISIONS_FILENAME),
+          tracer=self._tracer)
     if (config.telemetry_dir and config.sentinel
         and config.telemetry_poll_secs and perf_lib.plane_enabled()):
       # The fleet sentinel (ISSUE 15): gin-tunable rules evaluated
-      # over every aggregated poll; a page-severity breach triggers
-      # the flight-recorder path below, role-named like the hang path.
+      # over every aggregated poll; a page-severity breach first
+      # offers itself to the controller's act tier (ISSUE 18 — a
+      # successful remediation demotes the page), and only an
+      # unremediated breach triggers the flight-recorder path below,
+      # role-named like the hang path.
       self._sentinel = sentinel_lib.Sentinel(
           sentinel_lib.fleet_watches(),
           alerts_path=os.path.join(config.telemetry_dir,
                                    sentinel_lib.ALERTS_FILENAME),
+          on_act=(self._controller.handle_alert
+                  if self._controller is not None else None),
           on_page=self._sentinel_page,
           tracer=self._tracer)
     parent_conn, child_conn = self._ctx.Pipe()
@@ -710,8 +803,12 @@ class Fleet:
     here, so a long-lived fleet absorbs occasional churn forever
     while a crash-loop trips the budget within one window."""
     window = self.config.restart_window_secs
-    limit = (self.config.max_learner_restarts if target == "learner"
-             else self.config.max_actor_restarts)
+    if target == "learner":
+      limit = self.config.max_learner_restarts
+    elif target.startswith("front-"):
+      limit = self.config.max_front_restarts
+    else:
+      limit = self.config.max_actor_restarts
     stamps = self._restart_times.setdefault(
         target, collections.deque())
     if window:
@@ -802,17 +899,27 @@ class Fleet:
         f"policy={self.config.actor_crash_policy!r}")
 
   def _handle_front_failure(self, index: int, fault: str,
+                            t_detected: Optional[float] = None,
                             **detail: Any) -> None:
-    """One lost front replica: SURVIVABLE membership shrink.
+    """One lost front replica: RESPAWN under the front rate budget
+    (ISSUE 18), membership SHRINK as the fallback (ISSUE 17).
 
     Fronts only serve — they hold no replay rows, no training lease,
-    and no actor act-traffic — so a death sheds load instead of
-    latching the fleet: routers fail the replica's tenants over to
+    and no actor act-traffic — so a death is never fatal. With
+    `front_respawn` on and budget left, the replica is respawned at
+    its ORIGINAL index; the fresh address replaces the old one in the
+    broadcast tree and the front observers are told "respawned" so a
+    router owner re-admits it via `mark_alive(index, address)` — no
+    manual step. Respawn off / budget spent / mid-shutdown: the
+    survivable shrink — routers fail the replica's tenants over to
     HRW survivors on their side within one client deadline (the
     placement remap touches ONLY the lost replica's tenants), and
     the orchestrator prunes the broadcast tree so the next publish
     fans over the survivors instead of erroring at the dead child.
     """
+    if t_detected is None:
+      t_detected = time.monotonic()
+    # The dead incarnation's bookkeeping goes either way.
     self._fronts.pop(index, None)
     name = f"t2r-fleet-front-{index}"
     self._heartbeats.pop(name, None)
@@ -827,8 +934,19 @@ class Fleet:
       self._aux_hosts.remove(entry)
     if self._addresses is not None:
       self._addresses.get("fronts", {}).pop(index, None)
-    event = {"fault": fault, "target": f"front-{index}",
-             "t_detected": time.monotonic()}
+    target = f"front-{index}"
+    if (self.config.front_respawn and not self._closed
+        and self._budget_ok(target)):
+      try:
+        address = self._respawn_front(index, fault, t_detected, detail)
+      except FleetError:
+        log.warning("front %d respawn failed; falling back to "
+                    "membership shrink", index, exc_info=True)
+      else:
+        self._notify_front_observers("respawned", index, address)
+        return
+    event = {"fault": fault, "target": target,
+             "t_detected": t_detected}
     event.update(detail)
     self.front_failures.append(event)
     if self._tracer is not None:
@@ -841,6 +959,66 @@ class Fleet:
     except Exception:  # noqa: BLE001 — best-effort rewire
       log.warning("broadcast rewire after front loss failed",
                   exc_info=True)
+    self._notify_front_observers("lost", index, None)
+
+  def _respawn_front(self, index: int, fault: str, t_detected: float,
+                     detail: Dict[str, Any]) -> Tuple[str, int]:
+    """Respawns one front replica at its original index; returns the
+    NEW address. A failed respawn unwinds its half-spawn bookkeeping
+    and raises `FleetError` (the caller falls back to the shrink)."""
+    self._front_restarts[index] = self._front_restarts.get(index, 0) + 1
+    self._charge_restart(f"front-{index}")
+    log.warning(
+        "front %d failed (%s %s); respawn %d (budget %d per %.0fs "
+        "window)", index, fault, detail, self._front_restarts[index],
+        self.config.max_front_restarts,
+        self.config.restart_window_secs)
+    entry, parent_conn, process, what = self._spawn_front(
+        self._run_config, index)
+    try:
+      entry["address"] = self._await_ready(
+          parent_conn, process, what,
+          self._run_config.launch_timeout_secs)
+    except FleetError:
+      self._fronts.pop(index, None)
+      self._heartbeats.pop(f"t2r-fleet-front-{index}", None)
+      self._spawned_at.pop(f"t2r-fleet-front-{index}", None)
+      if entry in self._aux_hosts:
+        self._aux_hosts.remove(entry)
+      if process.is_alive():
+        process.kill()
+        process.join(timeout=5.0)
+      raise
+    if self._addresses is not None:
+      self._addresses.setdefault("fronts", {})[index] = entry["address"]
+    self._begin_recovery(fault, f"front-{index}",
+                         f"t2r-fleet-front-{index}",
+                         t_detected=t_detected, **detail)
+    try:
+      self._configure_broadcast(self._run_config)
+    except Exception:  # noqa: BLE001 — best-effort rewire
+      log.warning("broadcast rewire after front respawn failed",
+                  exc_info=True)
+    return entry["address"]
+
+  def add_front_observer(
+      self, fn: Callable[[str, int, Any], None]) -> None:
+    """Registers a front-membership callback `(event, index,
+    address)`, event in {"respawned", "lost", "added", "removed"} —
+    the seam a `ServingRouter` owner uses to call
+    `mark_alive(index, address)` / `mark_dead(index)` so placement
+    tracks supervision with no manual step (ISSUE 18)."""
+    self._front_observers.append(fn)
+
+  def _notify_front_observers(self, event: str, index: int,
+                              address: Any) -> None:
+    for fn in list(self._front_observers):
+      try:
+        fn(event, index, address)
+      except Exception:  # noqa: BLE001 — an observer must never
+        # break supervision (it runs on the supervision thread).
+        log.warning("front observer failed on %s front %d", event,
+                    index, exc_info=True)
 
   def _check_heartbeats(self) -> None:
     """Hang detection. A stale ACTOR heartbeat is a recoverable fault
@@ -870,6 +1048,9 @@ class Fleet:
           continue
         log.warning("front %d heartbeat stale for %.0fs; killing the "
                     "hung replica", index, stale)
+        # MTTR starts at detection, like the actor hang path: the
+        # kill latency below is part of the outage.
+        t_detected = time.monotonic()
         process.terminate()
         process.join(timeout=5.0)
         if process.is_alive():
@@ -877,7 +1058,7 @@ class Fleet:
           process.join(timeout=5.0)
         self._handle_front_failure(
             index, faults_lib.SERVING_REPLICA_CRASH,
-            stale_secs=round(stale, 1))
+            t_detected=t_detected, stale_secs=round(stale, 1))
         continue
       if is_actor and self.config.actor_crash_policy == "restart":
         index = int(name.rsplit("-", 1)[1])
@@ -983,8 +1164,17 @@ class Fleet:
     if self._sentinel is not None:
       # Watch rules over the SAME aggregated view that just landed in
       # fleet_metrics.jsonl — the sentinel sees exactly what the
-      # operator's dashboard would.
+      # operator's dashboard would. Page-severity breaches route
+      # through the controller's act tier (on_act) synchronously
+      # here, BEFORE the regular rule pass below.
       self._sentinel.evaluate(payload)
+    if self._controller is not None:
+      try:
+        self._controller.maybe_step(
+            payload, step=int(payload.get("replay.learner_step", 0)))
+      except Exception:  # noqa: BLE001 — the policy plane must never
+        # take down the supervision loop it advises.
+        log.warning("control step failed", exc_info=True)
 
   def _sentinel_page(self, alert: Dict[str, Any]) -> None:
     """Page-severity alert → the flight-recorder path: the
@@ -1003,10 +1193,15 @@ class Fleet:
         name: round(now - max(value.value,
                               self._spawned_at.get(name, 0.0)), 3)
         for name, value in self._heartbeats.items()}
+    extra: Dict[str, Any] = {"alert": alert,
+                             "heartbeat_ages_secs": ages,
+                             "actor_restarts": dict(self._restarts)}
+    if self._controller is not None:
+      # An escalated page means the act tier did NOT remediate; the
+      # decision tail shows why (cooldown, budget, actuator error).
+      extra["control"] = self._controller.flight_extra()
     flightrec.dump(
-        self._run_config.flightrec_dir, reason,
-        extra={"alert": alert, "heartbeat_ages_secs": ages,
-               "actor_restarts": dict(self._restarts)},
+        self._run_config.flightrec_dir, reason, extra=extra,
         role="orchestrator")
     if (self._control is not None and self._host is not None
         and self._host.is_alive()):
@@ -1021,6 +1216,30 @@ class Fleet:
         self._control.close()
         self._control = self._fresh_control()
 
+  def _control_page(self, decision: Dict[str, Any]) -> None:
+    """The control plane's terminal lever (the `page` actuator): a
+    rule ran out of cheaper actions, so this decision escalates to a
+    human with the same flight-record artifact a sentinel page
+    produces — plus the controller's own recent-decision tail, so the
+    post-mortem shows every lever that was tried first."""
+    if not self._run_config.flightrec_dir:
+      return
+    reason = (f"control page: rule {decision.get('rule')} on "
+              f"{decision.get('metric')} (role {decision.get('role')})")
+    now = time.monotonic()
+    ages = {
+        name: round(now - max(value.value,
+                              self._spawned_at.get(name, 0.0)), 3)
+        for name, value in self._heartbeats.items()}
+    extra = {"decision": {k: v for k, v in decision.items()
+                          if k != "detail"},
+             "heartbeat_ages_secs": ages,
+             "actor_restarts": dict(self._restarts)}
+    if self._controller is not None:
+      extra["control"] = self._controller.flight_extra()
+    flightrec.dump(self._run_config.flightrec_dir, reason,
+                   extra=extra, role="orchestrator")
+
   def _flight_record(self, error: BaseException) -> None:
     """The latched-error / hang-detection flight-recorder trigger:
     dump the orchestrator's view (heartbeat ages name a HUNG process —
@@ -1034,11 +1253,15 @@ class Fleet:
         name: round(now - max(value.value,
                               self._spawned_at.get(name, 0.0)), 3)
         for name, value in self._heartbeats.items()}
+    extra: Dict[str, Any] = {"heartbeat_ages_secs": ages,
+                             "actor_restarts": dict(self._restarts)}
+    if self._controller is not None:
+      # What the control plane saw and did before the latch — the
+      # first question a post-mortem of a self-driving fleet asks.
+      extra["control"] = self._controller.flight_extra()
     flightrec.dump(
         self._run_config.flightrec_dir, f"fleet latched: {error!r}",
-        extra={"heartbeat_ages_secs": ages,
-               "actor_restarts": dict(self._restarts)},
-        role="orchestrator")
+        extra=extra, role="orchestrator")
     if (self._control is not None and self._host is not None
         and self._host.is_alive()):
       try:
@@ -1179,6 +1402,188 @@ class Fleet:
       if self._tracer is not None:
         self._tracer.event("fleet.scaled", actors=len(self._actors))
       log.info("fleet scaled to %d actors", len(self._actors))
+
+  @property
+  def num_actors(self) -> int:
+    return len(self._actors)
+
+  @property
+  def num_fronts(self) -> int:
+    return len(self._fronts)
+
+  def scale_fronts_to(self, num_fronts: int) -> None:
+    """Elastic FRONT-tier membership (ISSUE 18): grow under fresh
+    indices (observers told "added" for router admission), shrink by
+    draining the highest-indexed replicas via their RPC `shutdown`
+    (observers told "removed" first, so routers stop placing tenants
+    on a replica that is about to leave). Either way the broadcast
+    tree is rewired over the result. Safe from another thread while
+    `wait()` supervises, exactly like `scale_to`."""
+    if num_fronts < 1:
+      raise ValueError(f"num_fronts must be >= 1, got {num_fronts}")
+    with self._scale_lock:
+      if not self._launched or self._closed:
+        raise FleetError("scale_fronts_to() needs a launched, open "
+                         "fleet")
+      current = sorted(self._fronts)
+      delta = num_fronts - len(current)
+      if delta == 0:
+        return
+      now = time.monotonic()
+      if delta > 0:
+        pending = []
+        for _ in range(delta):
+          index = self._next_front_index
+          self._next_front_index += 1
+          pending.append(self._spawn_front(self._run_config, index))
+        deadline = (time.monotonic()
+                    + self._run_config.launch_timeout_secs)
+        for entry, parent_conn, process, what in pending:
+          entry["address"] = self._await_ready(
+              parent_conn, process, what,
+              max(0.0, deadline - time.monotonic()))
+          if self._addresses is not None:
+            self._addresses.setdefault(
+                "fronts", {})[entry["index"]] = entry["address"]
+          self.scale_events.append(
+              {"action": "add_front", "index": entry["index"],
+               "t": now})
+          self._notify_front_observers("added", entry["index"],
+                                       entry["address"])
+      else:
+        for index in current[delta:]:
+          self._notify_front_observers("removed", index, None)
+          process = self._fronts.pop(index)
+          entry = next(
+              (e for e in self._aux_hosts
+               if e["kind"] == "front" and e["index"] == index), None)
+          if entry is not None:
+            try:
+              self._aux_call(entry, "shutdown", timeout_secs=10.0)
+            except Exception:  # noqa: BLE001 — join/kill below wins
+              log.warning("front %d shutdown rpc failed", index,
+                          exc_info=True)
+            if entry["client"] is not None:
+              entry["client"].close()
+              entry["client"] = None
+            self._aux_hosts.remove(entry)
+          if self._addresses is not None:
+            self._addresses.get("fronts", {}).pop(index, None)
+          self._heartbeats.pop(f"t2r-fleet-front-{index}", None)
+          self._spawned_at.pop(f"t2r-fleet-front-{index}", None)
+          self._join_or_kill(process, 30.0, f"front host {index}")
+          self.scale_events.append(
+              {"action": "remove_front", "index": index, "t": now})
+      try:
+        self._configure_broadcast(self._run_config)
+      except Exception:  # noqa: BLE001 — best-effort rewire
+        log.warning("broadcast rewire after front scale failed",
+                    exc_info=True)
+      tmetrics.gauge("fleet.fronts").set(len(self._fronts))
+      if self._tracer is not None:
+        self._tracer.event("fleet.fronts_scaled",
+                           fronts=len(self._fronts))
+      log.info("fleet scaled to %d fronts", len(self._fronts))
+
+  def kick(self, role: str) -> None:
+    """Targeted kill-and-respawn of one RECOVERABLE role (ISSUE 18 —
+    the `respawn_role` actuator's seam): the process is terminated
+    and the EXISTING failure paths take over, so an actor respawns
+    under the actor budget and a front under the front budget, with
+    the same MTTR accounting as an organic crash. Accepts telemetry
+    role names (`actor-3`, `front1`); anything else — learner, host,
+    shard, "fleet" — raises (those roles are load-bearing: kicking
+    them IS an outage, not a remediation)."""
+    match = re.fullmatch(r"(actor|front)-?(\d+)", role)
+    if match is None:
+      raise FleetError(
+          f"role {role!r} is not kickable (only actor-N / front-N "
+          f"are recoverable by respawn)")
+    kind, index = match.group(1), int(match.group(2))
+    with self._scale_lock:
+      if not self._launched or self._closed:
+        raise FleetError("kick() needs a launched, open fleet")
+      processes = self._actors if kind == "actor" else self._fronts
+      process = processes.get(index)
+      if process is None or process.exitcode is not None:
+        raise FleetError(f"{role} is not running (already respawned "
+                         f"or scaled away?)")
+      target = f"{kind}-{index}"
+      if not self._budget_ok(target):
+        # Check BEFORE the kill: a kick with no respawn budget would
+        # turn a remediation into an outage.
+        raise FleetError(
+            f"no restart budget left for {target}; refusing to kick")
+      t_detected = time.monotonic()
+      log.warning("control plane kicking %s (slow-host remediation)",
+                  target)
+      process.terminate()
+      process.join(timeout=5.0)
+      if process.is_alive():
+        process.kill()
+        process.join(timeout=5.0)
+      if kind == "actor":
+        self._handle_actor_failure(index, faults_lib.ACTOR_HANG,
+                                   t_detected=t_detected, kicked=True)
+      else:
+        self._handle_front_failure(
+            index, faults_lib.SERVING_REPLICA_CRASH,
+            t_detected=t_detected, kicked=True)
+
+  def retune_admission(self, tenant: str,
+                       rate_rps: Optional[float] = None,
+                       factor: Optional[float] = None,
+                       min_rate_rps: float = 1.0,
+                       max_rate_rps: Optional[float] = None,
+                       ) -> Dict[str, Any]:
+    """Fans one admission retune to EVERY front replica (each owns
+    its own `AdmissionController`; a tenant's budget is per replica,
+    matching how the router spreads a tenant). `factor` scales the
+    current rate; otherwise `rate_rps` is absolute (None = restore to
+    unlimited). Returns per-front replies; a failed front reports its
+    error instead of aborting the fan-out (the controller's decision
+    record carries both)."""
+    payload: Dict[str, Any] = {"tenant": str(tenant),
+                               "min_rate_rps": float(min_rate_rps)}
+    if factor is not None:
+      payload["factor"] = float(factor)
+    else:
+      payload["rate_rps"] = rate_rps
+    if max_rate_rps is not None:
+      payload["max_rate_rps"] = float(max_rate_rps)
+    replies: Dict[str, Any] = {}
+    for entry in [e for e in self._aux_hosts if e["kind"] == "front"]:
+      try:
+        replies[entry["name"]] = self._aux_call(
+            entry, "admission_retune", payload, timeout_secs=15.0)
+      except Exception as e:  # noqa: BLE001 — partial fan-out reported
+        log.warning("admission retune on %s failed", entry["name"],
+                    exc_info=True)
+        replies[entry["name"]] = {"error": repr(e)}
+    if self._tracer is not None:
+      self._tracer.event("fleet.admission_retuned", tenant=tenant,
+                         fronts=len(replies))
+    return replies
+
+  def _shed_retune(self, tenant: str,
+                   rate_rps: Optional[float] = None) -> None:
+    """The degradation ladder's retune callable: clamp (or restore,
+    rate None = unlimited) one tenant on every front."""
+    self.retune_admission(tenant, rate_rps=rate_rps)
+
+  def admission_slo_reports(self) -> Dict[str, Any]:
+    """Per-front SLO scorecards (`AdmissionController.slo_report`),
+    keyed by front name — the controller's retune rules and the
+    bench's goodput gates read these."""
+    reports: Dict[str, Any] = {}
+    for entry in [e for e in self._aux_hosts if e["kind"] == "front"]:
+      try:
+        reports[entry["name"]] = self._aux_call(
+            entry, "slo_report", timeout_secs=15.0)
+      except Exception:  # noqa: BLE001 — instrumentation only
+        log.warning("slo report from %s failed", entry["name"],
+                    exc_info=True)
+    return reports
 
   def wait(self) -> None:
     """Blocks until the learner exits cleanly; on any latched failure
@@ -1340,9 +1745,13 @@ class Fleet:
       if entry["client"] is not None:
         entry["client"].close()
         entry["client"] = None
+    if metrics is not None and self._controller is not None:
+      metrics["control"] = self._controller.stats()
     if self._telemetry_file is not None:
       self._telemetry_file.close()
       self._telemetry_file = None
+    if self._controller is not None:
+      self._controller.close()
     if self._sentinel is not None:
       self._sentinel.close()
     if self._tracer is not None:

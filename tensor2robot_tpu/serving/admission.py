@@ -47,6 +47,10 @@ from tensor2robot_tpu.telemetry import metrics as tmetrics
 
 OVERFLOW_POLICIES = ("drop", "block")
 
+# `retune(rate_rps=None)` means UNLIMITED, so "not provided" needs
+# its own sentinel.
+_UNSET = object()
+
 
 class RequestRejected(RuntimeError):
   """An admission gate shed this request (rate, queue bound, or block
@@ -59,7 +63,9 @@ class RequestRejected(RuntimeError):
 
 
 class TenantPolicy:
-  """One tenant's admission envelope (immutable once registered)."""
+  """One tenant's admission envelope. Policy OBJECTS are immutable;
+  a live retune (`AdmissionController.retune`, the control plane's
+  lever) swaps the whole policy atomically rather than mutating."""
 
   __slots__ = ("rate_rps", "burst", "max_queue", "overflow",
                "block_timeout_secs", "slo_ms")
@@ -278,6 +284,62 @@ class AdmissionController:
       bucket.refund(rows)
     self._count(tenant, "dropped", rows)
     self._count(tenant, "shed_queue", rows)
+
+  def retune(self, tenant: str,
+             rate_rps: object = _UNSET,
+             factor: Optional[float] = None,
+             burst: Optional[int] = None,
+             min_rate_rps: float = 1.0,
+             max_rate_rps: Optional[float] = None) -> TenantPolicy:
+    """Live-retunes a REGISTERED tenant's token rate (ISSUE 18 — the
+    control plane's `retune_admission` actuator and the degradation
+    ladder both land here).
+
+    Either an absolute ``rate_rps`` (None = unlimited — the restore
+    path) or a multiplicative ``factor`` over the current rate; the
+    result clamps to ``[min_rate_rps, max_rate_rps]``. A ``factor``
+    on an unlimited tenant grants ``max_rate_rps`` (you cannot scale
+    infinity down; the cap is the starting point) and is a no-op when
+    no cap is given. The policy swap is atomic under the controller
+    lock and the bucket is REBUILT at the new rate — a shed tenant's
+    hoarded burst tokens must not outlive the retune. Raises
+    `KeyError` for an unregistered tenant (retuning a tenant that
+    never registered would silently create policy out of thin air).
+    """
+    with self._lock:
+      current = self._policies.get(tenant)
+      if current is None:
+        raise KeyError(f"unknown tenant {tenant!r}: retune needs a "
+                       f"registered policy")
+      new_rate = current.rate_rps
+      if factor is not None:
+        if factor <= 0:
+          raise ValueError(f"factor must be positive, got {factor}")
+        if new_rate is None:
+          new_rate = max_rate_rps  # may stay None: no cap, no-op
+        else:
+          new_rate = new_rate * factor
+      elif rate_rps is not _UNSET:
+        new_rate = None if rate_rps is None else float(rate_rps)
+      if new_rate is not None:
+        new_rate = max(new_rate, float(min_rate_rps))
+        if max_rate_rps is not None:
+          new_rate = min(new_rate, float(max_rate_rps))
+      policy = TenantPolicy(
+          rate_rps=new_rate,
+          burst=int(burst) if burst is not None else current.burst,
+          max_queue=current.max_queue,
+          overflow=current.overflow,
+          block_timeout_secs=current.block_timeout_secs,
+          slo_ms=current.slo_ms)
+      self._policies[tenant] = policy
+      if policy.rate_rps is None:
+        self._buckets.pop(tenant, None)
+      else:
+        self._buckets[tenant] = _TokenBucket(policy.rate_rps,
+                                             policy.burst)
+    self._count(tenant, "retunes", 1)
+    return policy
 
   def _bucket(self, tenant: str,
               policy: TenantPolicy) -> Optional[_TokenBucket]:

@@ -144,14 +144,27 @@ class ServingRouter:
 
   def mark_alive(self, index: int,
                  address: Optional[Tuple[str, int]] = None) -> None:
-    """Re-adds a replica (a respawned front) to the placement set."""
+    """Re-adds a replica (a respawned front) to the placement set.
+
+    Any pooled clients for the index are stale by definition — they
+    hold sockets to the PREVIOUS incarnation (a respawn binds a fresh
+    port), and checking one out would fail the first call and demote
+    the replica straight back to dead (fatal when it is the only
+    one). Flush them here so the next predict dials the new address.
+    """
     with self._lock:
       if address is not None:
         self._addresses[int(index)] = tuple(address)
       if index not in self._addresses:
         raise KeyError(f"unknown replica {index}")
       self._alive.add(int(index))
+      stale = self._pool.pop(int(index), [])
       self._tm_alive.set(len(self._alive))
+    for client in stale:
+      try:
+        client.close()
+      except Exception:  # noqa: BLE001 — teardown of a dead peer
+        pass
 
   # ---- version / dedup plumbing ----
 

@@ -12,11 +12,18 @@ already produces, and a breach
     ``alert.fired`` counter and a per-rule counter,
   * appends one JSON record to ``alerts.jsonl`` next to the run's
     other telemetry files (the report tool's alert log),
-  * on ``severity="page"`` invokes the caller's page hook — the
-    trainers dump a flight record; the fleet orchestrator dumps its
-    own view AND requests a host dump, naming the offending role
-    exactly as the hang path does — so a regression self-documents
-    with the same artifact a crash gets.
+  * ESCALATES through the severity tiers (ISSUE 18):
+    ``log`` → record only; ``warn`` → the warning log; ``act`` →
+    the caller's act hook (the control plane's remediation entry);
+    ``page`` → the act hook FIRST — a remediation that reports the
+    alert handled DEMOTES the page to the act tier — and only an
+    unremediated breach invokes the page hook. Flight records stay
+    the TERMINAL tier: the trainers dump a flight record; the fleet
+    orchestrator dumps its own view AND requests a host dump, naming
+    the offending role exactly as the hang path does — so an
+    unremediated regression self-documents with the same artifact a
+    crash gets. The record's ``escalation`` field names the tier
+    actually reached.
 
 Rule grammar (docs/OBSERVABILITY.md §"Sentinel"):
 
@@ -61,7 +68,10 @@ log = logging.getLogger(__name__)
 
 ALERTS_FILENAME = "alerts.jsonl"
 KINDS = ("above", "below", "increase", "ewma_drop", "ewma_spike")
-SEVERITIES = ("warn", "page")
+# Escalation tiers, mildest first. "act" asks the act hook (the
+# control plane) to remediate and never pages; "page" tries the act
+# hook first and pages only when unremediated (ISSUE 18).
+SEVERITIES = ("log", "warn", "act", "page")
 
 
 @gin.configurable
@@ -105,10 +115,14 @@ class _WatchState:
 class Sentinel:
   """Evaluates watches over flat scalar views at log cadence.
 
-  `on_page(record)` runs for every fired ``severity="page"`` alert —
-  the flight-recorder trigger. Evaluation is cheap (a dict scan per
-  watch) and never raises: a broken rule must not take down the train
-  loop it instruments.
+  `on_act(record) -> bool` is the remediation hook (the control
+  plane's `Controller.handle_alert`): it runs for ``act`` and
+  ``page`` severities, and returning True on a page DEMOTES it — the
+  remediation acted, so no flight records. `on_page(record)` runs
+  only for alerts that ESCALATE to the page tier — the
+  flight-recorder trigger stays terminal. Evaluation is cheap (a
+  dict scan per watch) and never raises: a broken rule must not take
+  down the train loop it instruments.
   """
 
   def __init__(self,
@@ -116,10 +130,12 @@ class Sentinel:
                alerts_path: Optional[str] = None,
                on_page: Optional[Callable[[Dict[str, Any]], None]] = None,
                registry: Optional[tmetrics.MetricsRegistry] = None,
-               tracer: Optional[core.Tracer] = None):
+               tracer: Optional[core.Tracer] = None,
+               on_act: Optional[Callable[[Dict[str, Any]], bool]] = None):
     self.watches = list(watches)
     self._alerts_path = alerts_path
     self._on_page = on_page
+    self._on_act = on_act
     # `tracer`: where alert.<rule> events land. None = the
     # process-global tracer; the fleet orchestrator passes its private
     # one (it may supervise from inside a process with its own
@@ -227,21 +243,41 @@ class Sentinel:
     }
     if step is not None:
       record["step"] = int(step)
-    log.warning("sentinel alert.%s: %s=%.6g (baseline %s, %s %s) "
-                "severity=%s", watch.name, key, value, baseline,
-                watch.kind, watch.threshold, watch.severity)
+    log.log(logging.INFO if watch.severity == "log" else logging.WARNING,
+            "sentinel alert.%s: %s=%.6g (baseline %s, %s %s) "
+            "severity=%s", watch.name, key, value, baseline,
+            watch.kind, watch.threshold, watch.severity)
     (self._tracer.event if self._tracer is not None else core.event)(
         f"alert.{watch.name}", metric=key,
         value=round(value, 6), severity=watch.severity)
     self._registry.counter("alert.fired").inc()
     self._registry.counter(f"alert.{watch.name}").inc()
+    # Escalation (ISSUE 18): act/page severities offer the alert to
+    # the remediation hook first; a handled page DEMOTES to the act
+    # tier and flight records stay terminal.
+    escalation = watch.severity
+    if watch.severity in ("act", "page") and self._on_act is not None:
+      handled = False
+      try:
+        handled = bool(self._on_act(record))
+      except Exception:  # noqa: BLE001 — a broken remediation must
+        # not mask the alert (nor block the page below).
+        log.warning("sentinel act hook failed", exc_info=True)
+      record["handled"] = handled
+      if handled:
+        self._registry.counter("alert.remediated").inc()
+        if watch.severity == "page":
+          escalation = "act"
+    record["escalation"] = escalation
     self.alerts.append(record)
     self._append(record)
-    if watch.severity == "page" and self._on_page is not None:
-      try:
-        self._on_page(record)
-      except Exception:  # noqa: BLE001 — forensics must not mask
-        log.warning("sentinel page hook failed", exc_info=True)
+    if escalation == "page":
+      self._registry.counter("alert.paged").inc()
+      if self._on_page is not None:
+        try:
+          self._on_page(record)
+        except Exception:  # noqa: BLE001 — forensics must not mask
+          log.warning("sentinel page hook failed", exc_info=True)
     return record
 
   def _append(self, record: Dict[str, Any]) -> None:
