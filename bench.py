@@ -1708,7 +1708,8 @@ def bench_fleet(dry_run: bool = False):
   bench run (qtopt_fleet.gin for the loopback leg, qtopt_fleet_tcp.gin
   for every TCP leg).
 
-  Four legs (docs/FLEET.md §"Cross-host fleets"):
+  Five legs (docs/FLEET.md §"Cross-host fleets" / §"Hybrid
+  Podracer"):
     * the wire microbench — in-band pickle vs out-of-band protocol-5
       framing over a real socket pair, MB/s + copies counted;
     * the committed single-host loopback baseline (the headline
@@ -1717,7 +1718,12 @@ def bench_fleet(dry_run: bool = False):
       topology with every RPC riding fleet/transport.py frames;
     * the cross-host TCP legs — 2 serving hosts + 2 replay shard
       hosts on real ports, at 2 and 4 actors, with per-hop
-      param_refresh_lag and shard-namespaced staleness.
+      param_refresh_lag and shard-namespaced staleness;
+    * the hybrid Podracer legs (ISSUE 19) — one Anakin pod
+      (vectorized on-device collector) vs the process-actor leg on
+      the SAME cross-host TCP wire, gated at >= 5x env-steps/s, then
+      the same pod fleet under a 2-process learner group (rank-0-only
+      publication, committed rows required).
 
   The bench REFUSES TO COMMIT (SystemExit before any detail write)
   unless the out-of-band wire is >= 2x the in-band rate at every
@@ -1751,9 +1757,13 @@ def bench_fleet(dry_run: bool = False):
   tcp_gate = os.path.join(configs_dir, "qtopt_fleet_tcp.gin")
 
   def _config(transport="loopback", num_actors=2, serving_hosts=1,
-              replay_hosts=0):
+              replay_hosts=0, pod_hosts=0, learner_hosts=1):
     return FleetConfig(
         num_actors=num_actors,
+        pod_hosts=pod_hosts,
+        envs_per_pod=8 if tiny else 64,
+        pod_rollout_length=2 if tiny else 4,
+        learner_hosts=learner_hosts,
         env="mujoco_pose",
         image_size=16 if tiny else 32,
         action_dim=2,
@@ -1801,6 +1811,8 @@ def bench_fleet(dry_run: bool = False):
     section = {
         "transport": config.transport,
         "num_actors": config.num_actors,
+        "pod_hosts": config.pod_hosts,
+        "learner_hosts": config.learner_hosts,
         "serving_hosts": config.serving_hosts,
         "replay_shard_hosts": config.replay_hosts,
         "env_steps_per_sec": round(result.env_steps_per_sec, 1),
@@ -1866,6 +1878,56 @@ def bench_fleet(dry_run: bool = False):
     cross_host[f"actors_{actors}"] = _section(
         cross_config, _run_leg(cross_config, tcp_gate))
 
+  # Hybrid Podracer (ISSUE 19) on the SAME cross-host TCP wire as the
+  # legs above. Dry run: ONE tiny all-in leg (1 pod + 1 process actor
+  # + a 2-process learner group) so tier-1 smokes every hybrid seam in
+  # a single fleet. Full run: the head-to-head the acceptance gate
+  # reads — a pod-only fleet (num_actors=0, learner group 1) against
+  # the 2-process-actor cross-host leg, then the same pod fleet under
+  # a 2-process learner group (grad-steps/s at group size 1 vs 2,
+  # rank-0-only publication).
+  hybrid_gate = os.path.join(configs_dir, "qtopt_fleet_hybrid.gin")
+  hybrid = {}
+  if tiny:
+    hybrid_config = _config(transport="tcp", num_actors=1,
+                            serving_hosts=2, replay_hosts=2,
+                            pod_hosts=1, learner_hosts=2)
+    hybrid["pod_actor_group2"] = _section(
+        hybrid_config, _run_leg(hybrid_config, hybrid_gate))
+  else:
+    pod_config = _config(transport="tcp", num_actors=0,
+                         serving_hosts=2, replay_hosts=2, pod_hosts=1)
+    pod_leg = _section(pod_config, _run_leg(pod_config, hybrid_gate))
+    hybrid["pod_group1"] = pod_leg
+    actor_leg = cross_host["actors_2"]
+    pod_vs_actors = round(
+        pod_leg["env_steps_per_sec"]
+        / max(actor_leg["env_steps_per_sec"], 1e-9), 2)
+    hybrid["pod_vs_process_actors"] = pod_vs_actors
+    if pod_vs_actors < 5.0:
+      raise SystemExit(
+          f"hybrid pod gate FAILED: one Anakin pod ingested "
+          f"{pod_leg['env_steps_per_sec']} env-steps/s vs the "
+          f"2-process-actor leg's {actor_leg['env_steps_per_sec']} "
+          f"on the same TCP wire ({pod_vs_actors}x < 5x); refusing "
+          f"to commit.")
+    group_config = _config(transport="tcp", num_actors=0,
+                           serving_hosts=2, replay_hosts=2,
+                           pod_hosts=1, learner_hosts=2)
+    group_leg = _section(group_config,
+                         _run_leg(group_config, hybrid_gate))
+    hybrid["pod_group2"] = group_leg
+    if not group_leg["publishes"] or group_leg["params_version"] < 1:
+      raise SystemExit(
+          "hybrid learner-group gate FAILED: the 2-process group "
+          f"published {group_leg['publishes']} version(s) "
+          f"(params_version={group_leg['params_version']}) — rank-0 "
+          "publication is broken; refusing to commit.")
+    if not (group_leg["committed_transitions"] or 0):
+      raise SystemExit(
+          "hybrid learner-group gate FAILED: no committed cross-host "
+          "rows under the 2-process group; refusing to commit.")
+
   return {
       "device_kind": jax.devices()[0].device_kind,
       "host_cores": os.cpu_count(),
@@ -1886,6 +1948,7 @@ def bench_fleet(dry_run: bool = False):
       "wire_serialization": wire,
       "tcp_same_host": tcp_same_host,
       "cross_host_tcp": cross_host,
+      "hybrid_podracer": hybrid,
       "note": (
           "real multi-process runs on this host: every organ crossed "
           "a process boundary (actions via the host's micro-batched "
@@ -4448,11 +4511,14 @@ def main():
     # — the single-host loopback leg, a tiny CROSS-HOST TCP leg
     # (2 serving hosts + 2 replay shard hosts on real ports, every
     # RPC through fleet/transport.py, qtopt_fleet_tcp.gin as the
-    # launch gate), and the tiny wire microbench — NO detail-file
-    # write.
+    # launch gate), the tiny wire microbench, and the tiny hybrid
+    # Podracer leg (1 pod + 1 process actor + a 2-process learner
+    # group, qtopt_fleet_hybrid.gin as the launch gate) — NO
+    # detail-file write.
     smoke = bench_fleet(dry_run=True)
     tcp_leg = smoke["cross_host_tcp"]["actors_2"]
     wire_row = smoke["wire_serialization"]["payloads"][0]
+    hybrid_leg = smoke["hybrid_podracer"]["pod_actor_group2"]
     print(json.dumps({
         "fleet_dry_run": "ok",
         "num_actors": smoke["num_actors"],
@@ -4469,6 +4535,10 @@ def main():
         "wire_oob_speedup": wire_row["oob_speedup"],
         "wire_oob_copies": [wire_row["oob_send_payload_copies"],
                             wire_row["oob_recv_payload_copies"]],
+        "hybrid_env_steps_per_sec": hybrid_leg["env_steps_per_sec"],
+        "hybrid_publishes": hybrid_leg["publishes"],
+        "hybrid_params_version": hybrid_leg["params_version"],
+        "hybrid_clean_shutdown": hybrid_leg["clean_shutdown"],
     }))
     return
   if "--chaos" in args and "--dry-run" in args:

@@ -41,6 +41,11 @@ WORKER_SAFE_MODULES = (
     "tensor2robot_tpu.fleet.transport",
     "tensor2robot_tpu.fleet.proc",
     "tensor2robot_tpu.fleet.actor",
+    # ISSUE 19: the Anakin pod module defers every jax touch into
+    # pod_main's body (after the RPC handshake) so supervision code
+    # importing it — and the spawn closure itself up to the collect
+    # loop — stays XLA-free like the process actor it rides beside.
+    "tensor2robot_tpu.fleet.pod",
     # ISSUE 14: the fault-injection plan rides inside FleetConfig to
     # every child, actors included — the chaos rig must never drag an
     # XLA runtime into a jax-free actor.

@@ -48,6 +48,7 @@ def fleet_rules(
     mfu_drop_fraction: float = 0.35,
     retune_factor: float = 0.8,
     cooldown_secs: float = 60.0,
+    offered_load_slope_max: float = 0.0,
 ) -> List[ControlRule]:
   """The ordered autopilot table over the aggregated fleet view.
 
@@ -68,6 +69,25 @@ def fleet_rules(
           warmup=4, sustain=3, aggregate="each",
           action="respawn_role", cooldown_secs=3 * cooldown_secs,
           alert="mfu_drop"),
+  ]
+  if offered_load_slope_max > 0.0:
+    # PREDICTIVE pre-scale (ISSUE 19, the ROADMAP control item): the
+    # admitted-rows counter's per-second rate IS the offered load the
+    # front tier absorbs, so a sustained climb past the slope bound
+    # grows the tier BEFORE queueing pushes the p95 over the SLO —
+    # the reactive p95/queue rules below remain the backstop. Rows/s
+    # across the worst replica; default off (0.0): the right slope is
+    # per deployment, like the env-steps band.
+    rules.append(ControlRule(
+        name="front_offered_prescale",
+        metric=f"serving.{tenant}.admission.admitted",
+        kind="rate_above", threshold=offered_load_slope_max,
+        warmup=1, sustain=2, aggregate="max",
+        action="scale_fronts",
+        action_params={"delta": 1, "min": min_fronts,
+                       "max": max_fronts},
+        cooldown_secs=cooldown_secs))
+  rules.extend([
       # Goodput pressure: the worst replica's e2e p95 over the SLO
       # grows the front tier; hysteresis re-arms at 80% of the SLO.
       ControlRule(
@@ -87,7 +107,7 @@ def fleet_rules(
           action_params={"delta": 1, "min": min_fronts,
                          "max": max_fronts},
           cooldown_secs=cooldown_secs),
-  ]
+  ])
   if env_steps_per_sec_min > 0.0:
     # Hold the collection rate: the replay commit counter's
     # per-second rate under the band adds an actor...

@@ -42,8 +42,14 @@ from tensor2robot_tpu.research.pose_env.pose_env import (
 BACKGROUND = 96
 BLOCK_COLOR = (200, 40, 40)
 
-_LOW = jnp.asarray(WORKSPACE_LOW)
-_HIGH = jnp.asarray(WORKSPACE_HIGH)
+# Keep the reference np.float32 arrays as-is: a module-level
+# `jnp.asarray` is an import-time jax computation that initializes the
+# XLA backend, which breaks any later `jax.distributed.initialize` in
+# the importing process (the learner-group hazard; see
+# preprocessors/image_transformations.py). jnp ops consume them
+# identically.
+_LOW = WORKSPACE_LOW
+_HIGH = WORKSPACE_HIGH
 
 
 @flax.struct.dataclass

@@ -274,6 +274,26 @@ class _HostState:
               # The acting host's broadcast-tree depth: actors stamp
               # it into commits so lag is attributable PER HOP.
               "params_hop": self._tree_depth}
+    if method == "acting_state":
+      # Whole-params refresh for Anakin pods (ISSUE 19): a pod acts
+      # ON ITS OWN DEVICES (the env and the Q-network are one pmapped
+      # program), so instead of per-step `act` RPCs it pulls the
+      # published acting state and runs with it until the version
+      # moves. `have_version` makes the poll cheap: an unchanged
+      # version returns the stamp alone, no state payload.
+      publication = self.policy_server.engine.publication
+      have = (int(payload.get("have_version", -1))
+              if isinstance(payload, dict) else -1)
+      reply: Dict[str, Any] = {
+          "params_version": publication.version,
+          "params_learner_step": publication.learner_step,
+          "params_hop": self._tree_depth,
+          "state": None,
+      }
+      if publication.version != have and publication.state is not None:
+        import jax
+        reply["state"] = jax.device_get(publication.state)
+      return reply
     if method in ("commit", "begin_episode", "append", "end_episode",
                   "sample", "size"):
       if self.replay is None:

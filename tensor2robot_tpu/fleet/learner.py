@@ -223,30 +223,79 @@ class _FaultPlanHook(Hook):
           f"injected learner crash (fault plan, step {step})")
 
 
+def learner_group_plan(config, world_size: int = 1,
+                       rank: int = 0) -> Dict[str, Any]:
+  """The learner group's per-rank contract, as pure math (ISSUE 19).
+
+  One place decides what a rank DOES so tests can pin it without
+  spawning processes: every rank samples and feeds `local_batch_size`
+  rows (the mesh assembles the global batch via
+  `make_array_from_process_local_data`), but ONLY rank 0 publishes
+  params and owns the side-effect surfaces (`train_qtopt` gates
+  checkpoints/logs on `jax.process_index() == 0`). At
+  `world_size == 1` this degenerates to exactly the single-learner
+  path — same role name, same batch, publishing on — which is what
+  keeps N=1 bitwise-pinned against it.
+  """
+  world_size = int(world_size)
+  rank = int(rank)
+  if world_size < 1:
+    raise ValueError(f"world_size must be >= 1, got {world_size}")
+  if not 0 <= rank < world_size:
+    raise ValueError(
+        f"rank must be in [0, {world_size}), got {rank}")
+  if config.batch_size % world_size != 0:
+    raise ValueError(
+        f"batch_size ({config.batch_size}) must divide evenly "
+        f"across the learner group (world_size={world_size})")
+  return {
+      "role": "learner" if rank == 0 else f"learner-r{rank}",
+      "local_batch_size": config.batch_size // world_size,
+      "publishes": rank == 0,
+  }
+
+
 def learner_main(config, model_dir: str, address, heartbeat,
                  coordinator_address: Optional[str] = None,
-                 incarnation: int = 0) -> None:
+                 incarnation: int = 0, world_size: int = 1,
+                 rank: int = 0) -> None:
   """Child-process entry: connect → train_qtopt → clean exit.
 
   ``incarnation`` > 0 is the `learner_crash_policy="resume"` respawn:
   `train_qtopt` restores from the latest checkpoint in `model_dir`
   (the host kept the replay store and serving engine alive), and
   non-recurring planned faults do not re-fire.
+
+  ``world_size`` > 1 makes this process rank ``rank`` of a LEARNER
+  GROUP (ISSUE 19): every rank adopts the same ephemeral coordinator,
+  `maybe_initialize_distributed` joins them into one gloo mesh, and
+  the unmodified jitted train step runs as one cross-process GSPMD
+  program — each rank feeds its own `batch_size / world_size` replay
+  shard and the mesh all-reduces the gradients. Rank 0 is the chief:
+  the only rank that publishes params, writes checkpoints, and logs.
   """
+  plan = learner_group_plan(config, world_size, rank)
   proc.scrub_inherited_distributed_env()
   telemetry.configure(
-      "learner",
+      plan["role"],
       trace_dir=getattr(config, "telemetry_dir", "") or None)
-  injector = faults_lib.install(config, "learner",
+  injector = faults_lib.install(config, plan["role"],
                                 incarnation=incarnation)
   if incarnation:
     log.warning("learner incarnation %d: resuming from the latest "
                 "checkpoint in %s", incarnation, model_dir)
-  if config.distributed_learner and coordinator_address:
+  if world_size > 1:
+    # Group ranks present ONE host device each to the gloo mesh — an
+    # inherited forced multi-device CPU topology tears the group's
+    # first collective (see proc.pin_single_host_device).
+    proc.pin_single_host_device()
+  if coordinator_address and (config.distributed_learner
+                              or world_size > 1):
     # The orchestrator picked this address with
     # ephemeral_coordinator_address(); adopt it before any jax use so
     # concurrent fleets on one host never race on a fixed port.
-    proc.adopt_coordinator(coordinator_address)
+    proc.adopt_coordinator(coordinator_address,
+                           num_processes=world_size, process_id=rank)
 
   rpc_kwargs = dict(
       authkey=config.authkey,
@@ -269,6 +318,8 @@ def learner_main(config, model_dir: str, address, heartbeat,
         maybe_initialize_distributed,
     )
     maybe_initialize_distributed()
+    tmetrics.gauge("fleet.learner_group.size").set(world_size)
+    tmetrics.gauge("fleet.learner_group.rank").set(rank)
 
     from tensor2robot_tpu.fleet.host import _build_learner
     from tensor2robot_tpu.research.qtopt.train_qtopt import train_qtopt
@@ -283,12 +334,16 @@ def learner_main(config, model_dir: str, address, heartbeat,
     replay = RemoteReplay(control, stream, capacity=hello["capacity"],
                           shard_controls=shard_controls,
                           shard_streams=shard_streams)
-    hooks = [ParamPublishHook(
-        control,
-        telemetry_push=bool(getattr(config, "telemetry_dir", ""))),
-        _HeartbeatHook(heartbeat)]
-    if config.learner_crash_after_steps:
-      hooks.append(_CrashAfterHook(config.learner_crash_after_steps))
+    hooks: List[Hook] = [_HeartbeatHook(heartbeat)]
+    if plan["publishes"]:
+      # Rank 0 only: publication (and the crash-injection hooks that
+      # model "the learner" dying — a group death is modelled by the
+      # chief; any rank's death is fatal either way).
+      hooks.insert(0, ParamPublishHook(
+          control,
+          telemetry_push=bool(getattr(config, "telemetry_dir", ""))))
+      if config.learner_crash_after_steps:
+        hooks.append(_CrashAfterHook(config.learner_crash_after_steps))
     if injector.active:
       hooks.append(_FaultPlanHook(injector))
     train_qtopt(
@@ -296,7 +351,11 @@ def learner_main(config, model_dir: str, address, heartbeat,
         model_dir=model_dir,
         replay_buffer=replay,
         max_train_steps=config.max_train_steps,
-        batch_size=config.batch_size,
+        # The PER-PROCESS batch: `device_put_batch` assembles the
+        # global batch from every rank's local shard, so the group
+        # trains on `batch_size` rows total per step — same global
+        # batch as the single learner, split across samplers.
+        batch_size=plan["local_batch_size"],
         min_replay_size=config.min_replay_size,
         save_checkpoints_steps=config.publish_every_steps,
         log_every_steps=config.log_every_steps,

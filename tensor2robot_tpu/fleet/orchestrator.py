@@ -60,6 +60,7 @@ from tensor2robot_tpu.fleet import faults as faults_lib
 from tensor2robot_tpu.fleet import front as front_lib
 from tensor2robot_tpu.fleet import host as host_lib
 from tensor2robot_tpu.fleet import learner as learner_lib
+from tensor2robot_tpu.fleet import pod as pod_lib
 from tensor2robot_tpu.fleet.rpc import RpcClient, TRANSPORTS
 from tensor2robot_tpu.telemetry import core as tcore
 from tensor2robot_tpu.telemetry import flightrec
@@ -158,6 +159,29 @@ class FleetConfig:
   serving_hosts: int = 1
   replay_hosts: int = 0
   broadcast_degree: int = 2
+  # Hybrid Podracer (ISSUE 19). learner_hosts > 1 spawns a LEARNER
+  # GROUP: every rank adopts ONE ephemeral gloo coordinator
+  # (`parallel.distributed`), the `parallel/` mesh spans all ranks'
+  # devices, and the jitted train step runs as one cross-process
+  # GSPMD program — gradients all-reduce over the mesh with no
+  # train-loop changes. Each rank samples its own batch_size/N
+  # shard-fanout batch from the replay plane; ONLY rank 0 publishes
+  # params and writes checkpoints (`train_qtopt` gates every side
+  # effect on `jax.process_index() == 0`). N=1 is bitwise the
+  # single-learner path; any group member's death is fatal (the
+  # collective is torn), so learner_hosts > 1 requires
+  # learner_crash_policy="fatal". pod_hosts > 0 spawns Anakin PODS
+  # (`fleet.pod`): vectorized on-device collectors — envs_per_pod
+  # functional envs vmapped inside pmap roll pod_rollout_length steps
+  # per segment, acting params refreshed from the pod's assigned
+  # serving replica ("acting_state"), whole segments committed
+  # atomically to the pod's rendezvous-hashed home shard. Pods
+  # coexist with (or, with num_actors=0, replace) process actors in
+  # the same supervised lifecycle and share the actor restart budget.
+  learner_hosts: int = 1
+  pod_hosts: int = 0
+  envs_per_pod: int = 64
+  pod_rollout_length: int = 4
   # Replicated serving-front tier (ISSUE 17). front_hosts > 0 spawns
   # that many `fleet.front.front_main` replicas — each a complete
   # multi-tenant ServingFront (arena + admission + continuous
@@ -273,8 +297,39 @@ class FleetConfig:
       # Listener SKIP the auth challenge the Client then waits for
       # (a handshake deadlock, found the hard way).
       self.authkey = secrets.token_bytes(16)
-    if self.num_actors < 1:
-      raise ValueError(f"num_actors must be >= 1, got {self.num_actors}")
+    if self.num_actors < 0:
+      raise ValueError(f"num_actors must be >= 0, got {self.num_actors}")
+    if self.num_actors < 1 and self.pod_hosts < 1:
+      raise ValueError(
+          "the fleet needs at least one collector: num_actors >= 1 or "
+          "pod_hosts >= 1")
+    if self.learner_hosts < 1:
+      raise ValueError(
+          f"learner_hosts must be >= 1, got {self.learner_hosts}")
+    if self.batch_size % self.learner_hosts != 0:
+      raise ValueError(
+          f"batch_size ({self.batch_size}) must divide evenly across "
+          f"the learner group (learner_hosts={self.learner_hosts}): "
+          "each rank samples and feeds batch_size/learner_hosts rows")
+    if self.learner_hosts > 1 and self.learner_crash_policy != "fatal":
+      raise ValueError(
+          "learner_hosts > 1 requires learner_crash_policy='fatal': a "
+          "group member's death tears the gloo collective, so the only "
+          "sound recovery is a full-group teardown")
+    if self.pod_hosts < 0:
+      raise ValueError(f"pod_hosts must be >= 0, got {self.pod_hosts}")
+    if self.envs_per_pod < 1:
+      raise ValueError(
+          f"envs_per_pod must be >= 1, got {self.envs_per_pod}")
+    if self.pod_rollout_length < 1:
+      raise ValueError(
+          f"pod_rollout_length must be >= 1, got "
+          f"{self.pod_rollout_length}")
+    if self.pod_hosts and self.env == "toy_grasp":
+      raise ValueError(
+          "pod_hosts requires a functional env family (pose/"
+          "mujoco_pose/procgen): Anakin pods vmap the env inside pmap, "
+          "which toy_grasp's stateful host env cannot do")
     if self.env not in _ENVS:
       raise ValueError(f"env must be one of {_ENVS}, got {self.env!r}")
     if self.actor_crash_policy not in _CRASH_POLICIES:
@@ -411,8 +466,19 @@ class Fleet:
     self._aux_hosts: List[Dict[str, Any]] = []
     self._addresses: Optional[Dict[str, Any]] = None
     self._learner: Optional[mp.Process] = None
+    # Learner group (ISSUE 19): ranks 1..N-1 of the multi-process
+    # learner. Rank 0 stays `self._learner` so every existing
+    # supervision/restart path sees the group through its chief; any
+    # peer's death is fatal (the collective is torn).
+    self._learner_peers: Dict[int, mp.Process] = {}
     self._actors: Dict[int, mp.Process] = {}
     self._actor_stops: Dict[int, Any] = {}
+    # Anakin pods (ISSUE 19): vectorized collectors supervised like
+    # actors (same crash policy + restart budget), drained like actors
+    # at shutdown so their final commits land before the metrics read.
+    self._pods: Dict[int, mp.Process] = {}
+    self._pod_stops: Dict[int, Any] = {}
+    self._pod_restarts: Dict[int, int] = {}
     self._draining: List[Tuple[int, mp.Process]] = []
     self._heartbeats: Dict[str, Any] = {}
     self._spawned_at: Dict[str, float] = {}
@@ -431,6 +497,7 @@ class Fleet:
     # from another thread while wait() supervises.
     self._scale_lock = threading.RLock()
     self._next_actor_index = config.num_actors
+    self._next_pod_index = config.pod_hosts
     self._control: Optional[RpcClient] = None
     self._address: Optional[Tuple[str, int]] = None
     self._error: Optional[BaseException] = None
@@ -489,21 +556,48 @@ class Fleet:
     process.start()
     self._actors[index] = process
 
+  def _spawn_pod(self, index: int, incarnation: int) -> None:
+    name = f"t2r-fleet-pod-{index}"
+    heartbeat = self._heartbeat(name)
+    stop = self._pod_stops.get(index)
+    if stop is None:
+      stop = self._pod_stops[index] = self._ctx.Event()
+    process = self._ctx.Process(
+        target=pod_lib.pod_main,
+        args=(self._run_config, index, self._addresses or self._address,
+              stop, heartbeat, incarnation),
+        name=name, daemon=True)
+    process.start()
+    self._pods[index] = process
+
   def _spawn_learner(self, incarnation: int = 0) -> None:
+    config = self._run_config
+    world = int(getattr(config, "learner_hosts", 1))
     coordinator_address = None
-    if self._run_config.distributed_learner:
+    if config.distributed_learner or world > 1:
       from tensor2robot_tpu.parallel.distributed import (
           ephemeral_coordinator_address,
       )
       coordinator_address = ephemeral_coordinator_address()
     self._learner = self._ctx.Process(
         target=learner_lib.learner_main,
-        args=(self._run_config, self.model_dir,
+        args=(config, self.model_dir,
               self._addresses or self._address,
               self._heartbeat("t2r-fleet-learner"), coordinator_address,
-              incarnation),
+              incarnation, world, 0),
         name="t2r-fleet-learner", daemon=True)
     self._learner.start()
+    for rank in range(1, world):
+      name = f"t2r-fleet-learner-r{rank}"
+      process = self._ctx.Process(
+          target=learner_lib.learner_main,
+          args=(config, self.model_dir,
+                self._addresses or self._address,
+                self._heartbeat(name), coordinator_address,
+                incarnation, world, rank),
+          name=name, daemon=True)
+      process.start()
+      self._learner_peers[rank] = process
 
   def _await_ready(self, parent_conn: Any, process: mp.Process,
                    what: str, timeout_secs: float) -> Tuple[str, int]:
@@ -779,12 +873,17 @@ class Fleet:
     for index in range(config.num_actors):
       self._restarts[index] = 0
       self._spawn_actor(index, incarnation=0)
+    for index in range(config.pod_hosts):
+      self._pod_restarts[index] = 0
+      self._spawn_pod(index, incarnation=0)
     self._spawn_learner(incarnation=0)
     self._launched = True
     self._t_launched = time.monotonic()
     if self._tracer is not None:
       self._tracer.event("orchestrator.launched",
-                         actors=config.num_actors)
+                         actors=config.num_actors,
+                         pods=config.pod_hosts,
+                         learner_hosts=config.learner_hosts)
 
   # ---- supervision ----
 
@@ -896,6 +995,41 @@ class Fleet:
         f"{self.config.restart_window_secs:.0f}s window) exhausted"
         if self.config.actor_crash_policy == "restart" else
         f"actor {index} died ({fault}, {detail}) under "
+        f"policy={self.config.actor_crash_policy!r}")
+
+  def _handle_pod_failure(self, index: int, fault: str,
+                          t_detected: Optional[float] = None,
+                          **detail: Any) -> None:
+    """One dead/hung Anakin pod: same contract as an actor failure —
+    the pod's staged rows were begin/commit-atomic on the shard host,
+    so a respawn reopens a fresh session and no partial segment ever
+    lands (`adds_total % (envs_per_pod * pod_rollout_length) == 0`
+    is the pin)."""
+    target = f"pod-{index}"
+    if (self.config.actor_crash_policy == "restart"
+        and self._budget_ok(target)):
+      self._pod_restarts[index] += 1
+      self._charge_restart(target)
+      log.warning(
+          "pod %d failed (%s %s); restart %d (budget %d per %.0fs "
+          "window) — segments are committed atomically so no partial "
+          "rows survive", index, fault, detail,
+          self._pod_restarts[index], self.config.max_actor_restarts,
+          self.config.restart_window_secs)
+      if t_detected is None:
+        t_detected = time.monotonic()
+      self._spawn_pod(index, incarnation=self._pod_restarts[index])
+      self._begin_recovery(fault, target, f"t2r-fleet-pod-{index}",
+                           t_detected=t_detected, **detail)
+      return
+    raise FleetError(
+        f"pod {index} died ({fault}, {detail}) under "
+        f"policy={self.config.actor_crash_policy!r} after "
+        f"{self._pod_restarts[index]} restart(s) — restart budget "
+        f"({self.config.max_actor_restarts} per "
+        f"{self.config.restart_window_secs:.0f}s window) exhausted"
+        if self.config.actor_crash_policy == "restart" else
+        f"pod {index} died ({fault}, {detail}) under "
         f"policy={self.config.actor_crash_policy!r}")
 
   def _handle_front_failure(self, index: int, fault: str,
@@ -1031,7 +1165,11 @@ class Fleet:
     now = time.monotonic()
     for name, value in list(self._heartbeats.items()):
       is_actor = name.startswith("t2r-fleet-actor-")
-      timeout = actor_timeout if is_actor else global_timeout
+      # Pods stamp per-segment like actors stamp per-batch, so they
+      # share the collector timeout AND the kill-and-respawn policy.
+      is_pod = name.startswith("t2r-fleet-pod-")
+      timeout = (actor_timeout if (is_actor or is_pod)
+                 else global_timeout)
       if not timeout:
         continue
       last = max(value.value, self._spawned_at.get(name, 0.0))
@@ -1059,6 +1197,23 @@ class Fleet:
         self._handle_front_failure(
             index, faults_lib.SERVING_REPLICA_CRASH,
             t_detected=t_detected, stale_secs=round(stale, 1))
+        continue
+      if is_pod and self.config.actor_crash_policy == "restart":
+        index = int(name.rsplit("-", 1)[1])
+        process = self._pods.get(index)
+        if process is None:
+          continue  # drained by a concurrent scale_pods_to
+        log.warning("pod %d heartbeat stale for %.0fs; killing the "
+                    "hung process for respawn", index, stale)
+        t_detected = time.monotonic()
+        process.terminate()
+        process.join(timeout=5.0)
+        if process.is_alive():
+          process.kill()
+          process.join(timeout=5.0)
+        self._handle_pod_failure(index, faults_lib.ACTOR_HANG,
+                                 t_detected=t_detected,
+                                 stale_secs=round(stale, 1))
         continue
       if is_actor and self.config.actor_crash_policy == "restart":
         index = int(name.rsplit("-", 1)[1])
@@ -1195,7 +1350,8 @@ class Fleet:
         for name, value in self._heartbeats.items()}
     extra: Dict[str, Any] = {"alert": alert,
                              "heartbeat_ages_secs": ages,
-                             "actor_restarts": dict(self._restarts)}
+                             "actor_restarts": dict(self._restarts),
+                             "pod_restarts": dict(self._pod_restarts)}
     if self._controller is not None:
       # An escalated page means the act tier did NOT remediate; the
       # decision tail shows why (cooldown, budget, actuator error).
@@ -1234,7 +1390,8 @@ class Fleet:
     extra = {"decision": {k: v for k, v in decision.items()
                           if k != "detail"},
              "heartbeat_ages_secs": ages,
-             "actor_restarts": dict(self._restarts)}
+             "actor_restarts": dict(self._restarts),
+             "pod_restarts": dict(self._pod_restarts)}
     if self._controller is not None:
       extra["control"] = self._controller.flight_extra()
     flightrec.dump(self._run_config.flightrec_dir, reason,
@@ -1254,7 +1411,8 @@ class Fleet:
                               self._spawned_at.get(name, 0.0)), 3)
         for name, value in self._heartbeats.items()}
     extra: Dict[str, Any] = {"heartbeat_ages_secs": ages,
-                             "actor_restarts": dict(self._restarts)}
+                             "actor_restarts": dict(self._restarts),
+                             "pod_restarts": dict(self._pod_restarts)}
     if self._controller is not None:
       # What the control plane saw and did before the latch — the
       # first question a post-mortem of a self-driving fleet asks.
@@ -1290,6 +1448,16 @@ class Fleet:
   def _supervise_once(self) -> bool:
     """One poll; returns True when the learner finished cleanly."""
     with self._scale_lock:
+      # Learner-group peers first: a dead rank tears the gloo
+      # collective, so rank 0 is (or soon will be) wedged inside an
+      # all-reduce — the peer's exit code is the honest root cause.
+      for rank, process in self._learner_peers.items():
+        if process.exitcode is not None and process.exitcode != 0:
+          raise FleetError(
+              f"learner group rank {rank} died (exit "
+              f"{process.exitcode}): the collective is torn, so the "
+              "whole group is lost (learner_crash_policy='fatal' is "
+              "the only sound policy for learner_hosts > 1)")
       learner = self._learner
       if learner.exitcode is not None:
         if learner.exitcode == 0:
@@ -1350,6 +1518,11 @@ class Fleet:
         # scale-down drain, both of which remove the actor first).
         self._handle_actor_failure(index, faults_lib.ACTOR_CRASH,
                                    exitcode=process.exitcode)
+      for index, process in list(self._pods.items()):
+        if process.exitcode is None:
+          continue
+        self._handle_pod_failure(index, faults_lib.ACTOR_CRASH,
+                                 exitcode=process.exitcode)
       self._reap_draining()
       self._check_heartbeats()
       self._complete_recoveries()
@@ -1406,6 +1579,56 @@ class Fleet:
   @property
   def num_actors(self) -> int:
     return len(self._actors)
+
+  @property
+  def num_pods(self) -> int:
+    return len(self._pods)
+
+  def scale_pods_to(self, num_pods: int) -> None:
+    """Elastic POD membership (ISSUE 19), mirroring `scale_to`:
+    grow under fresh indices, shrink by setting the highest-indexed
+    pods' per-pod stop events — each finishes (and commits) its
+    current segment and exits, joined by the supervision drain.
+    0 is allowed when process actors remain: pods and actors are
+    interchangeable collectors and the fleet needs only one of the
+    two tiers to stay non-empty."""
+    if num_pods < 0:
+      raise ValueError(f"num_pods must be >= 0, got {num_pods}")
+    with self._scale_lock:
+      if not self._launched or self._closed:
+        raise FleetError("scale_pods_to() needs a launched, open "
+                         "fleet")
+      if num_pods == 0 and not self._actors:
+        raise FleetError(
+            "scale_pods_to(0) would leave the fleet with no "
+            "collectors (no process actors remain)")
+      current = sorted(self._pods)
+      delta = num_pods - len(current)
+      if delta == 0:
+        return
+      now = time.monotonic()
+      if delta > 0:
+        for _ in range(delta):
+          index = self._next_pod_index
+          self._next_pod_index += 1
+          self._pod_restarts[index] = 0
+          self._spawn_pod(index, incarnation=0)
+          self.scale_events.append(
+              {"action": "add_pod", "index": index, "t": now})
+      else:
+        for index in current[delta:]:
+          process = self._pods.pop(index)
+          self._pod_stops.pop(index).set()
+          name = f"t2r-fleet-pod-{index}"
+          self._heartbeats.pop(name, None)
+          self._spawned_at.pop(name, None)
+          self._draining.append((index, process))
+          self.scale_events.append(
+              {"action": "remove_pod", "index": index, "t": now})
+      tmetrics.gauge("fleet.pods").set(len(self._pods))
+      if self._tracer is not None:
+        self._tracer.event("fleet.scaled_pods", pods=len(self._pods))
+      log.info("fleet scaled to %d pods", len(self._pods))
 
   @property
   def num_fronts(self) -> int:
@@ -1494,16 +1717,17 @@ class Fleet:
     role names (`actor-3`, `front1`); anything else — learner, host,
     shard, "fleet" — raises (those roles are load-bearing: kicking
     them IS an outage, not a remediation)."""
-    match = re.fullmatch(r"(actor|front)-?(\d+)", role)
+    match = re.fullmatch(r"(actor|front|pod)-?(\d+)", role)
     if match is None:
       raise FleetError(
-          f"role {role!r} is not kickable (only actor-N / front-N "
-          f"are recoverable by respawn)")
+          f"role {role!r} is not kickable (only actor-N / front-N / "
+          f"pod-N are recoverable by respawn)")
     kind, index = match.group(1), int(match.group(2))
     with self._scale_lock:
       if not self._launched or self._closed:
         raise FleetError("kick() needs a launched, open fleet")
-      processes = self._actors if kind == "actor" else self._fronts
+      processes = {"actor": self._actors, "front": self._fronts,
+                   "pod": self._pods}[kind]
       process = processes.get(index)
       if process is None or process.exitcode is not None:
         raise FleetError(f"{role} is not running (already respawned "
@@ -1525,6 +1749,9 @@ class Fleet:
       if kind == "actor":
         self._handle_actor_failure(index, faults_lib.ACTOR_HANG,
                                    t_detected=t_detected, kicked=True)
+      elif kind == "pod":
+        self._handle_pod_failure(index, faults_lib.ACTOR_HANG,
+                                 t_detected=t_detected, kicked=True)
       else:
         self._handle_front_failure(
             index, faults_lib.SERVING_REPLICA_CRASH,
@@ -1623,9 +1850,11 @@ class Fleet:
 
   def _all_processes(self) -> List[mp.Process]:
     procs = list(self._actors.values())
+    procs.extend(self._pods.values())
     procs.extend(process for _, process in self._draining)
     if self._learner is not None:
       procs.append(self._learner)
+    procs.extend(self._learner_peers.values())
     if self._host is not None:
       procs.append(self._host)
     procs.extend(self._serving.values())
@@ -1653,11 +1882,20 @@ class Fleet:
       self._closed = True
       for stop in self._actor_stops.values():
         stop.set()
+      for stop in self._pod_stops.values():
+        stop.set()
       actors = list(self._actors.items())
+      pods = list(self._pods.items())
       draining = list(self._draining)
     for index, process in actors + draining:
       self._join_or_kill(process, timeout_secs / 2,
                          f"actor {index}")
+    # Pods drain BEFORE the final metrics read, like actors: their
+    # last segment commit and telemetry push must land on the hosts
+    # the reads below aggregate.
+    for index, process in pods:
+      self._join_or_kill(process, timeout_secs / 2,
+                         f"pod {index}")
     metrics = None
     if (collect_metrics and self._host is not None
         and self._host.is_alive()):
@@ -1730,6 +1968,9 @@ class Fleet:
       self._control = None
     if self._learner is not None:
       self._join_or_kill(self._learner, timeout_secs / 2, "learner")
+    for rank, process in self._learner_peers.items():
+      self._join_or_kill(process, timeout_secs / 2,
+                         f"learner rank {rank}")
     if self._host is not None:
       self._join_or_kill(self._host, timeout_secs / 2, "host")
     for index, process in self._serving.items():
@@ -1780,7 +2021,7 @@ class Fleet:
     if metrics is None:
       raise FleetError("fleet completed but final metrics were lost")
     result = _result_from_metrics(metrics, wall, sum(
-        self._restarts.values()))
+        self._restarts.values()) + sum(self._pod_restarts.values()))
     result.recoveries = list(self.recoveries)
     result.learner_restarts = self._learner_restarts
     result.scale_events = list(self.scale_events)

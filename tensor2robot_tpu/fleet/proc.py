@@ -37,6 +37,26 @@ def scrub_inherited_distributed_env() -> None:
     os.environ.pop(var, None)
 
 
+def pin_single_host_device() -> None:
+  """Forces ONE host-platform device in this process's XLA runtime.
+
+  Learner-group ranks (ISSUE 19, `learner_hosts > 1`) must present a
+  symmetric single-device topology to gloo: the CPU backend's
+  cross-process collectives desync when each rank carries a forced
+  multi-device host platform (a parent that set
+  `--xla_force_host_platform_device_count=8` — the test suite does —
+  hands every spawned rank 8 fake devices, and the group's first
+  collective tears with a gloo preamble-size mismatch). Strip any
+  inherited count and pin 1; the flag only affects the host platform,
+  so this is a no-op on real accelerators. Must run before the
+  process's first jax import.
+  """
+  flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+           if not f.startswith("--xla_force_host_platform_device_count")]
+  flags.append("--xla_force_host_platform_device_count=1")
+  os.environ["XLA_FLAGS"] = " ".join(flags)
+
+
 def adopt_coordinator(address: str, num_processes: int = 1,
                       process_id: int = 0) -> None:
   """Installs an orchestrator-issued coordinator triple into env.

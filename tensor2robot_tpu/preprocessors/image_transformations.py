@@ -19,6 +19,7 @@ from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def to_float(images: jax.Array, dtype=jnp.float32) -> jax.Array:
@@ -71,12 +72,19 @@ def random_flip_left_right(key: jax.Array, images: jax.Array) -> jax.Array:
 # Photometric distortions (train-time only, float images in [0, 1])
 # ---------------------------------------------------------------------------
 
-_RGB_TO_YIQ = jnp.array([[0.299, 0.587, 0.114],
-                         [0.596, -0.274, -0.322],
-                         [0.211, -0.523, 0.312]])
-_YIQ_TO_RGB = jnp.array([[1.0, 0.956, 0.621],
-                         [1.0, -0.272, -0.647],
-                         [1.0, -1.106, 1.703]])
+# Plain numpy on purpose: a module-level `jnp.array` is a jax
+# COMPUTATION at import time, which initializes the XLA backend in any
+# process whose import closure reaches this file — and a
+# `jax.distributed.initialize` after that point raises (learner-group
+# ranks under the real `run_t2r_trainer` binary hit exactly this:
+# multiprocessing's spawn re-imports `__main__` before the child's
+# `learner_main` runs). jnp consumes these np constants identically.
+_RGB_TO_YIQ = np.array([[0.299, 0.587, 0.114],
+                        [0.596, -0.274, -0.322],
+                        [0.211, -0.523, 0.312]], dtype=np.float32)
+_YIQ_TO_RGB = np.array([[1.0, 0.956, 0.621],
+                        [1.0, -0.272, -0.647],
+                        [1.0, -1.106, 1.703]], dtype=np.float32)
 
 
 def adjust_brightness(images: jax.Array, delta: jax.Array) -> jax.Array:

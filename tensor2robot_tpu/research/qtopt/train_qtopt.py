@@ -108,7 +108,19 @@ def train_qtopt(
       save_checkpoints_steps=save_checkpoints_steps,
       max_train_steps=max_train_steps)
   os.makedirs(model_dir, exist_ok=True)
-  metric_logger = MetricLogger(model_dir)
+  # Multi-process learner group (ISSUE 19): every rank runs the SAME
+  # jitted program (one GSPMD computation over the shared mesh, each
+  # rank feeding its local batch shard), but HOST-side effects —
+  # metric logs, sentinel pages, replay step-tags — belong to the
+  # chief alone. Rank > 0 would otherwise race the chief on the same
+  # model_dir files. Checkpoint saves are the one exception: orbax
+  # save/wait are COLLECTIVE (`sync_global_processes` barriers inside
+  # the writer), so every rank must make the calls — orbax's
+  # primary-host ownership still makes process 0 the only rank that
+  # writes checkpoint data. Single-process runs are process 0, so this
+  # is bitwise the existing path there.
+  chief = jax.process_index() == 0
+  metric_logger = MetricLogger(model_dir) if chief else None
   hook_list = HookList(list(hooks))
   # Compile-cache traffic → telemetry registry (the CompileWatch tap):
   # a warm-path recompile lands in this loop's log, not only under
@@ -124,7 +136,8 @@ def train_qtopt(
   from tensor2robot_tpu.utils import profiling
   perf_lib.start_resource_sampler(
       sources=[profiling.device_memory_source()])
-  watch_sentinel = sentinel_lib.build_for_run(model_dir)
+  watch_sentinel = (sentinel_lib.build_for_run(model_dir)
+                    if chief else None)
 
   if replay_buffer is None:
     replay_buffer = ReplayBuffer(learner.transition_specification())
@@ -166,7 +179,8 @@ def train_qtopt(
   # their teardown owner (the loop's try/finally).
   step = int(np.asarray(jax.device_get(state.step)))
   if k > 1 and step % k and step < max_train_steps:
-    metric_logger.close()
+    if metric_logger is not None:
+      metric_logger.close()
     raise ValueError(
         f"Resumed at step {step}, not a multiple of "
         f"steps_per_dispatch={k}: the checkpoint/log boundaries "
@@ -190,11 +204,13 @@ def train_qtopt(
 
   # Live MFU attribution: the SAME analytic denominator bench.py uses
   # (utils.profiling.analytic_flops — the ISSUE-15 shared-path pin),
-  # scaled to the mesh (batch_size is the GLOBAL batch; peak × devices
-  # keeps perf.mfu the per-chip fraction).
+  # scaled to the mesh (batch_size is PER-PROCESS, so × process_count
+  # is the global batch; peak × devices keeps perf.mfu the per-chip
+  # fraction).
   perf_meter = perf_lib.PerfMeter(
       flops_per_step=profiling.qtopt_step_flops(
-          learner, batch_size, params=state.train_state.params),
+          learner, batch_size * jax.process_count(),
+          params=state.train_state.params),
       peak_flops=profiling.device_peak_flops(),
       devices=mesh.size)
 
@@ -233,8 +249,11 @@ def train_qtopt(
   prefetcher = prefetch_lib.ShardedPrefetcher(
       stream, stream_sharding, buffer_size=depth)
   # The data plane tags rows with the learner step at add time; seed
-  # the tag before actors race the first dispatch.
-  tag_step = getattr(replay_buffer, "set_learner_step", None)
+  # the tag before actors race the first dispatch. Chief-only: on the
+  # sharded plane the tag is an RPC fan-out to every shard, and N
+  # ranks tagging the same step would N-plicate it.
+  tag_step = (getattr(replay_buffer, "set_learner_step", None)
+              if chief else None)
   if tag_step is not None:
     tag_step(step)
   step_rng = jax.random.PRNGKey(seed + 1)
@@ -264,7 +283,8 @@ def train_qtopt(
       if tag_step is not None:
         tag_step(step)  # one int store; actors tag adds with it
       hook_list.after_step(step, metrics)
-      if step % log_every_steps == 0 or step == max_train_steps:
+      if chief and (step % log_every_steps == 0
+                    or step == max_train_steps):
         scalars = jax.device_get(metrics)
         dt = time.time() - t_last
         scalars["grad_steps_per_sec"] = steps_since_log / max(dt, 1e-9)
@@ -295,6 +315,13 @@ def train_qtopt(
         t_last = time.time()
         steps_since_log = 0
       if step % save_checkpoints_steps == 0 or step == max_train_steps:
+        # EVERY rank saves (orbax's save barrier is collective; a
+        # chief-only call would wedge the chief in
+        # `sync_global_processes` while the peers train on) — orbax's
+        # primary-host rule keeps process 0 the only data writer.
+        # `after_checkpoint` runs on every rank too (rank > 0 carries
+        # no publish hook, so it is a no-op there) to keep per-rank
+        # hook bookkeeping in step.
         host_state = jax.device_get(state)
         writer.save(step, host_state,
                     params=host_state.train_state.params,
@@ -318,5 +345,6 @@ def train_qtopt(
     writer.close()
     if watch_sentinel is not None:
       watch_sentinel.close()
-    metric_logger.close()
+    if metric_logger is not None:
+      metric_logger.close()
   return state

@@ -1,0 +1,97 @@
+"""Worker binary for the learner-group N=1 bitwise pin.
+
+Launched twice by tests/test_fleet.py (TestLearnerGroup): once
+`plain` (single learner, jax.distributed never initialized) and once
+`group` (adopt an ephemeral coordinator → `maybe_initialize_distributed`
+— exactly the bring-up `fleet.learner` runs when `learner_hosts > 1`,
+collapsed to world_size=1). Both run the identical seeded train_qtopt
+recipe and dump the final params; the parent compares BITWISE. The
+ISSUE-19 acceptance pin: the group machinery at N=1 IS the
+single-learner path, not an approximation of it.
+
+Usage: learner_group_worker.py {plain|group} <out.npz> <model_dir>
+"""
+
+import os
+import sys
+import types
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+mode, outfile, model_dir = sys.argv[1], sys.argv[2], sys.argv[3]
+assert mode in ("plain", "group"), mode
+
+if mode == "group":
+  from tensor2robot_tpu.fleet import proc
+  from tensor2robot_tpu.parallel.distributed import (
+      ephemeral_coordinator_address,
+  )
+
+  # The fleet orchestrator's handoff: coordinator address via the env
+  # launch contract, adopted before jax wakes up.
+  proc.adopt_coordinator(ephemeral_coordinator_address(),
+                         num_processes=1, process_id=0)
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from tensor2robot_tpu.parallel import (  # noqa: E402
+    maybe_initialize_distributed,
+)
+
+initialized = maybe_initialize_distributed()
+if mode == "group":
+  assert initialized, "group trigger did not fire"
+  assert jax.process_count() == 1
+else:
+  assert not initialized, "plain worker must stay un-distributed"
+
+from tensor2robot_tpu.fleet.learner import (  # noqa: E402
+    learner_group_plan,
+)
+from tensor2robot_tpu.models import optimizers as opt_lib  # noqa: E402
+from tensor2robot_tpu.research.qtopt import (  # noqa: E402
+    GraspingQModel,
+    QTOptLearner,
+    ReplayBuffer,
+    train_qtopt,
+)
+from tensor2robot_tpu.specs import make_random_tensors  # noqa: E402
+
+
+def main():
+  model = GraspingQModel(
+      image_size=16, action_dim=2, torso_filters=(8,),
+      head_filters=(8,), dense_sizes=(16,),
+      create_optimizer_fn=lambda: opt_lib.create_optimizer(
+          learning_rate=1e-3))
+  learner = QTOptLearner(model, cem_population=8, cem_iterations=1,
+                         cem_elites=2)
+  spec = learner.transition_specification()
+  replay = ReplayBuffer(spec, capacity=64, seed=7)
+  replay.add(make_random_tensors(spec, batch_size=64, seed=3))
+  # The group path sizes its feed through the plan; at world_size=1
+  # the local shard IS the global batch.
+  plan = learner_group_plan(
+      types.SimpleNamespace(batch_size=8), world_size=1, rank=0)
+  assert plan["publishes"]
+  state = train_qtopt(
+      learner=learner,
+      model_dir=model_dir,
+      replay_buffer=replay,
+      max_train_steps=6,
+      batch_size=plan["local_batch_size"] if mode == "group" else 8,
+      save_checkpoints_steps=6,
+      log_every_steps=3,
+      seed=0)
+  params = jax.device_get(state.train_state.params)
+  leaves = jax.tree_util.tree_leaves_with_path(params)
+  np.savez(outfile, **{jax.tree_util.keystr(path): np.asarray(leaf)
+                       for path, leaf in leaves})
+  print("BITWISE_OK", mode)
+
+
+if __name__ == "__main__":
+  main()

@@ -380,6 +380,29 @@ class TestStandardPolicyTable:
         "recovered_restore")
     assert all(r.action != "page" for r in ctrl.rules)
 
+  def test_offered_load_prescale_rule(self):
+    # Predictive pre-scale (ISSUE 19): default OFF; when a slope bound
+    # is set, a rate_above rule on the admitted-rows counter scales
+    # the front tier BEFORE the reactive p95 rule can breach — so it
+    # must sit ahead of front_p95_scale_up in actuation priority.
+    base = [r.name for r in policies_lib.fleet_rules()]
+    assert "front_offered_prescale" not in base
+    rules = policies_lib.fleet_rules(offered_load_slope_max=200.0,
+                                     tenant="policy", max_fronts=3)
+    names = [r.name for r in rules]
+    assert names.index("front_offered_prescale") < names.index(
+        "front_p95_scale_up")
+    rule = next(r for r in rules
+                if r.name == "front_offered_prescale")
+    assert rule.kind == "rate_above"
+    assert rule.metric == "serving.policy.admission.admitted"
+    assert rule.threshold == 200.0
+    assert rule.action == "scale_fronts"
+    assert rule.action_params == {"delta": 1, "min": 1, "max": 3}
+    # Worst replica's offered load, not the average: one hot front
+    # must be enough to pre-scale.
+    assert rule.aggregate == "max"
+
   def test_respawn_role_requires_concrete_role(self):
     acts = fleet_actuators(object())
     with pytest.raises(ActuationError):

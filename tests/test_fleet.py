@@ -218,6 +218,7 @@ class TestActorImportClosure:
     code = (
         "import sys; "
         "import tensor2robot_tpu.fleet.actor, "
+        "tensor2robot_tpu.fleet.pod, "
         "tensor2robot_tpu.fleet.rpc, tensor2robot_tpu.fleet.proc, "
         "tensor2robot_tpu.research.qtopt.actor, "
         "tensor2robot_tpu.research.qtopt.grasping_env, "
@@ -234,6 +235,27 @@ class TestActorImportClosure:
     from tensor2robot_tpu.analysis import cli
 
     assert "tensor2robot_tpu/fleet" in cli._CONCURRENCY_PATHS
+
+  def test_entry_binary_import_initializes_no_backend(self):
+    # multiprocessing's spawn re-imports `__main__` in every fleet
+    # child BEFORE its target runs, and the shipped binary is that
+    # __main__ — so its import closure must not execute any jax
+    # computation: an initialized XLA backend makes the learner
+    # group's `jax.distributed.initialize` raise (found by driving
+    # qtopt_fleet_hybrid.gin through the real run_t2r_trainer; a
+    # module-level `jnp.array` constant was enough to trip it).
+    code = (
+        "import tensor2robot_tpu.bin.run_t2r_trainer; "
+        "from jax._src import xla_bridge; "
+        "assert not xla_bridge.backends_are_initialized(), "
+        "'entry import ran a jax computation'; "
+        "print('BACKEND_FREE')")
+    result = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=180, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert result.returncode == 0, result.stderr
+    assert "BACKEND_FREE" in result.stdout
 
 
 class TestHostSessionAbort:
@@ -300,6 +322,170 @@ class TestHostSessionAbort:
     assert observer.call("metrics")["param_refresh_lag"]["rows"] == 4
     committer.close()
     observer.close()
+
+  def test_acting_state_serves_params_once_per_version(self, host):
+    # The pod param seam (ISSUE 19): `acting_state` returns the full
+    # publication on a version move and a stamp-only reply otherwise,
+    # so a polling pod pays the state transfer once per publication.
+    config, address = host
+    pod = RpcClient(address, authkey=config.authkey)
+    first = pod.call("acting_state", {"have_version": -1})
+    # Version 0 exists from engine construction — a pod's first
+    # refresh always lands acting params.
+    assert first["params_version"] >= 0
+    assert first["state"] is not None
+    assert "params_learner_step" in first
+    assert "params_hop" in first
+    second = pod.call(
+        "acting_state", {"have_version": first["params_version"]})
+    assert second["state"] is None
+    assert second["params_version"] == first["params_version"]
+    assert second["params_learner_step"] == first["params_learner_step"]
+    pod.close()
+
+
+class TestLearnerGroup:
+  """The multi-process learner-group contract (ISSUE 19)."""
+
+  def test_plan_roles_shards_and_publication(self):
+    from tensor2robot_tpu.fleet.learner import learner_group_plan
+
+    config = _tiny_config()  # batch_size=16
+    solo = learner_group_plan(config, world_size=1, rank=0)
+    assert solo == {"role": "learner", "local_batch_size": 16,
+                    "publishes": True}
+    chief = learner_group_plan(config, world_size=2, rank=0)
+    assert chief["role"] == "learner"
+    assert chief["local_batch_size"] == 8
+    assert chief["publishes"] is True
+    peer = learner_group_plan(config, world_size=2, rank=1)
+    assert peer["role"] == "learner-r1"
+    assert peer["local_batch_size"] == 8
+    assert peer["publishes"] is False
+
+  def test_plan_rejects_bad_geometry(self):
+    from tensor2robot_tpu.fleet.learner import learner_group_plan
+
+    config = _tiny_config()
+    with pytest.raises(ValueError, match="divide"):
+      learner_group_plan(config, world_size=3, rank=0)
+    with pytest.raises(ValueError, match="rank"):
+      learner_group_plan(config, world_size=2, rank=2)
+
+  def test_config_rejects_unsound_group_geometry(self):
+    with pytest.raises(ValueError, match="divide"):
+      _tiny_config(learner_hosts=2, batch_size=15)
+    with pytest.raises(ValueError, match="fatal"):
+      _tiny_config(learner_hosts=2, learner_crash_policy="resume")
+    with pytest.raises(ValueError, match="collector"):
+      _tiny_config(num_actors=0, pod_hosts=0)
+
+  def test_non_chief_rank_owns_no_host_side_surface(
+      self, tmp_path, monkeypatch):
+    # The rank-0-only side-effect pin: a rank-1 process runs the same
+    # loop (its batch shard feeds the shared GSPMD program) and makes
+    # the COLLECTIVE checkpoint-save calls (orbax barriers pair across
+    # ranks; primary-host ownership keeps process 0 the data writer),
+    # but owns none of the chief's host-side surfaces — no train
+    # metrics, no sentinel pages.
+    import jax
+
+    from tensor2robot_tpu.models import optimizers as opt_lib
+    from tensor2robot_tpu.research.qtopt import (
+        GraspingQModel,
+        QTOptLearner,
+        train_qtopt,
+    )
+
+    model = GraspingQModel(
+        image_size=16, action_dim=2, torso_filters=(8,),
+        head_filters=(8,), dense_sizes=(16,),
+        create_optimizer_fn=lambda: opt_lib.create_optimizer(
+            learning_rate=1e-3))
+    learner = QTOptLearner(model, cem_population=8, cem_iterations=1,
+                           cem_elites=2)
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    model_dir = str(tmp_path / "rank1")
+    state = train_qtopt(
+        learner=learner, model_dir=model_dir, max_train_steps=4,
+        batch_size=8, save_checkpoints_steps=4, log_every_steps=2,
+        prefill_random=True)
+    assert int(np.asarray(state.step)) == 4  # it DID train
+    # ckpt/ is the collective surface (here process_count is 1, so
+    # this mocked rank doubles as orbax's primary host); every
+    # chief-only file — metrics_train.jsonl and friends — is absent.
+    assert os.listdir(model_dir) == ["ckpt"]
+
+  def test_single_member_group_is_bitwise_single_learner(
+      self, tmp_path):
+    # The N=1 acceptance pin: the learner-group path (coordinator
+    # adoption → jax.distributed init → plan-sized batch) produces
+    # BITWISE the single-learner params — the group machinery is the
+    # existing path at world_size=1, not an approximation of it.
+    import subprocess
+
+    worker = os.path.join(REPO, "tests", "learner_group_worker.py")
+    outputs = {}
+    for mode in ("plain", "group"):
+      outfile = str(tmp_path / f"{mode}.npz")
+      env = {k: v for k, v in os.environ.items()
+             if not k.startswith(("JAX_", "XLA_", "TPU"))}
+      env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+      env["TF_CPP_MIN_LOG_LEVEL"] = "2"
+      result = subprocess.run(
+          [sys.executable, worker, mode, outfile,
+           str(tmp_path / mode)],
+          env=env, capture_output=True, text=True, timeout=300)
+      assert result.returncode == 0, (
+          f"{mode} worker failed:\n{result.stdout}\n{result.stderr}")
+      assert "BITWISE_OK" in result.stdout
+      outputs[mode] = dict(np.load(outfile))
+    assert set(outputs["plain"]) == set(outputs["group"])
+    for key, plain in outputs["plain"].items():
+      grouped = outputs["group"][key]
+      assert plain.dtype == grouped.dtype, key
+      assert np.array_equal(plain, grouped), key
+
+
+class TestPodUnits:
+  """The pod module's pure seams (jax-free, like the module import)."""
+
+  def test_env_family_maps_onto_functional_envs(self):
+    from tensor2robot_tpu.fleet.pod import pod_env_family
+
+    assert pod_env_family("pose") == "pose"
+    assert pod_env_family("mujoco_pose") == "pose"
+    assert pod_env_family("procgen") == "procgen"
+    with pytest.raises(ValueError, match="functional"):
+      pod_env_family("toy_grasp")
+
+  def test_trim_devices_largest_dividing_prefix(self):
+    from tensor2robot_tpu.fleet.pod import trim_devices
+
+    devices = [f"d{i}" for i in range(8)]
+    assert trim_devices(devices, 32) == devices  # 8 | 32
+    assert trim_devices(devices, 12) == devices[:6]
+    assert trim_devices(devices, 7) == devices[:7]
+    assert trim_devices(devices[:3], 16) == devices[:2]
+    assert trim_devices(devices[:1], 5) == devices[:1]  # always valid
+
+  def test_pod_home_shard_remap_is_minimal(self):
+    # Rendezvous placement over the `pod-N` id namespace: shrinking
+    # the shard set remaps ONLY pods homed on the removed shard, and
+    # growing it moves pods ONLY onto the new shard — everyone else's
+    # segments keep landing where they always did.
+    from tensor2robot_tpu.fleet.actor import home_shard
+
+    pods = [f"pod-{k}" for k in range(32)]
+    with_three = {p: home_shard(p, 3) for p in pods}
+    with_two = {p: home_shard(p, 2) for p in pods}
+    displaced = [p for p in pods if with_three[p] == 2]
+    assert displaced  # the pin is vacuous if nobody homed on shard 2
+    for p in pods:
+      if with_three[p] != 2:
+        assert with_two[p] == with_three[p], p
+      if with_two[p] != with_three[p]:
+        assert with_three[p] == 2, p
 
 
 class TestFleetLifecycle:
@@ -383,3 +569,67 @@ class TestFleetLifecycle:
     with pytest.raises(FleetError, match="actor 0 died"):
       fleet.run()
     assert _fleet_children() == []
+
+
+class TestHybridPodracer:
+  """ISSUE 19 end-to-end: Anakin pods and the learner group live in
+  the supervised fleet, under the same atomic-commit and rank-0-only
+  publication contracts the unit pins promise."""
+
+  @pytest.mark.slow
+  def test_pod_commits_land_whole_across_pod_kill(self, tmp_path):
+    from tensor2robot_tpu.fleet import faults
+
+    shm_before = _shm_entries()
+    # A pods-only fleet (num_actors=0) with one planned mid-segment
+    # kill: the staged wire batch is aborted on disconnect, the
+    # restart policy respawns pod-0, and every landed row arrived in
+    # whole segment-sized commits.
+    plan = faults.FaultPlan(seed=0, events=(faults.FaultEvent(
+        fault=faults.ACTOR_CRASH, target="pod-0", at=2,
+        mode="mid_episode"),))
+    config = _tiny_config(
+        num_actors=0, pod_hosts=1, envs_per_pod=8,
+        pod_rollout_length=2, env="mujoco_pose", fault_plan=plan,
+        max_actor_restarts=2, restart_window_secs=600.0)
+    fleet = Fleet(config, str(tmp_path / "fleet"))
+    result = fleet.run()
+
+    assert result.clean_shutdown
+    assert result.actor_restarts >= 1  # the pod respawn is counted
+    assert [r["target"] for r in result.recoveries] == ["pod-0"]
+    assert result.recoveries[0]["fault"] == "actor_crash"
+    assert result.recoveries[0]["mttr_ms"] > 0
+    service = result.metrics["service"]
+    assert service["replay_aborted_episodes"] >= 1.0
+    segment_rows = config.envs_per_pod * config.pod_rollout_length
+    committed = int(service["replay_committed_transitions"])
+    assert committed > 0
+    assert committed % segment_rows == 0
+    assert _fleet_children() == []
+    del fleet
+    _assert_no_new_shm(shm_before)
+
+  @pytest.mark.slow
+  def test_hybrid_fleet_end_to_end(self, tmp_path):
+    shm_before = _shm_entries()
+    # The full hybrid topology, tiny: one process actor and one Anakin
+    # pod feed the same replay plane while a 2-process learner group
+    # trains over the shared mesh — and only rank 0 publishes (the
+    # publication counter and the engine version counter must agree,
+    # which a double-publishing rank 1 would break).
+    config = _tiny_config(
+        env="mujoco_pose", num_actors=1, pod_hosts=1,
+        envs_per_pod=8, pod_rollout_length=2, learner_hosts=2)
+    fleet = Fleet(config, str(tmp_path / "fleet"))
+    result = fleet.run()
+
+    assert result.clean_shutdown
+    assert result.metrics["store"]["adds_total"] > 0
+    assert result.metrics["learner_window"]["last_step"] == 16
+    assert result.publishes >= 2
+    assert result.params_version == result.publishes
+    assert result.param_refresh_lag["rows"] > 0
+    assert _fleet_children() == []
+    del fleet
+    _assert_no_new_shm(shm_before)
