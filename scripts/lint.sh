@@ -3,7 +3,8 @@
 #
 # Two stages, fail-fast ordering:
 #   1. Pure-AST families (jax tracing hazards, concurrency/lifecycle,
-#      worker import hygiene) — runs WITHOUT importing jax, asserted:
+#      worker import hygiene, fleet rpc wire contract, distributed
+#      SPMD correctness) — runs WITHOUT importing jax, asserted:
 #      a hazard in the data-plane/serving code costs ~a second to
 #      catch, not a jax+XLA import. This is also the path that stays
 #      usable inside plane-worker-safe tooling.
@@ -24,7 +25,7 @@ import sys
 
 from tensor2robot_tpu.analysis.cli import main
 
-rc = main(["--checks", "jax,concurrency,imports,obs"])
+rc = main(["--checks", "jax,concurrency,imports,obs,fleet,spmd"])
 if "jax" in sys.modules:
     print("lint.sh: the AST lint path imported jax — the fast-path "
           "invariant broke (see analysis/__init__.py)", file=sys.stderr)

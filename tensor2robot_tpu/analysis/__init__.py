@@ -1,6 +1,6 @@
 """t2rcheck — repo-native static analysis for tensor2robot_tpu.
 
-Three checker families, one CLI (``python -m tensor2robot_tpu.analysis``):
+The checker families, one CLI (``python -m tensor2robot_tpu.analysis``):
 
   * ``gin``         — static validation of shipped ``.gin`` configs
                       against real configurable signatures (no training
@@ -14,6 +14,17 @@ Three checker families, one CLI (``python -m tensor2robot_tpu.analysis``):
                       Rules ``CON3xx``.
   * ``imports``     — import hygiene for plane-worker-safe modules
                       (must never pull jax at import time). ``IMP4xx``.
+  * ``obs``         — literal telemetry metric names checked against
+                      docs/OBSERVABILITY.md's catalog. ``OBS5xx``.
+  * ``fleet``       — the RPC wire contract: literal ``.call("m")``
+                      sends (incl. through forwarders) resolved
+                      against the ``handle()`` dispatcher union, plus
+                      dead-handler detection. ``FLT5xx``.
+  * ``spmd``        — distributed correctness: collectives reached
+                      only under a process-identity gate (``SPMD601``)
+                      and module-level statements that run a jax
+                      computation at import time, escalated inside the
+                      entry binary's spawn import closure (``JAX205``).
 
 Everything except the ``gin`` family is pure ``ast`` — importing this
 package (and running those checks) never imports jax, which is what

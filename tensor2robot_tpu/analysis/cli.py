@@ -56,6 +56,17 @@ _GIN_PATHS = ("tensor2robot_tpu",)
 # against the docs/OBSERVABILITY.md catalog; tests/bench construct
 # fixture names on purpose and are out of scope.
 _OBS_PATHS = ("tensor2robot_tpu",)
+# fleet (FLT5xx, ISSUE 20) resolves string-literal rpc sends against
+# the union of handle() dispatchers — both live in fleet/ + serving/
+# (tests dial fixture methods on purpose and are out of scope).
+_FLEET_PATHS = (
+    "tensor2robot_tpu/fleet",
+    "tensor2robot_tpu/serving",
+)
+# spmd (SPMD601/JAX205, ISSUE 20) covers the whole package: chief
+# gates live in train loops, import-time backend hazards anywhere in
+# the entry binary's spawn closure.
+_SPMD_PATHS = ("tensor2robot_tpu",)
 
 
 def _resolve_paths(paths: Sequence[str], root: str) -> List[str]:
@@ -88,6 +99,16 @@ def run_checks(checks: Sequence[str], root: str,
       from tensor2robot_tpu.analysis.obs_rules import run_obs_rules
       findings.extend(run_obs_rules(
           _resolve_paths(paths or _OBS_PATHS, root), root))
+    elif family == "fleet":
+      from tensor2robot_tpu.analysis.fleet_rules import (
+          run_fleet_rules,
+      )
+      findings.extend(run_fleet_rules(
+          _resolve_paths(paths or _FLEET_PATHS, root), root))
+    elif family == "spmd":
+      from tensor2robot_tpu.analysis.spmd_rules import run_spmd_rules
+      findings.extend(run_spmd_rules(
+          _resolve_paths(paths or _SPMD_PATHS, root), root))
     elif family == "gin":
       from tensor2robot_tpu.analysis.gin_check import run_gin_rules
       findings.extend(run_gin_rules(
@@ -113,7 +134,7 @@ def build_parser() -> argparse.ArgumentParser:
                   "(gin validator, JAX tracing-hazard linter, "
                   "concurrency/lifecycle linter).")
   parser.add_argument(
-      "--checks", default="jax,concurrency,imports,obs,gin",
+      "--checks", default="jax,concurrency,imports,obs,fleet,spmd,gin",
       help="comma-separated families to run "
            f"({','.join(FAMILIES)}); note `gin` imports the "
            "framework, the rest are pure-AST")

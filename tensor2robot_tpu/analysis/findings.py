@@ -69,9 +69,26 @@ RULE_CATALOG: Dict[str, Tuple[str, str]] = {
     # observability hygiene (family "obs")
     "OBS501": ("obs", "Literal telemetry metric name missing from "
                       "docs/OBSERVABILITY.md's catalog"),
+    # fleet RPC wire contract (family "fleet")
+    "FLT501": ("fleet", "String-literal .call()/.call_once() rpc "
+                        "method that no handle() dispatcher in scope "
+                        "accepts"),
+    "FLT502": ("fleet", "handle() dispatcher arm whose method no "
+                        "call site in scope ever sends (dead "
+                        "handler)"),
+    # distributed SPMD correctness (family "spmd"; JAX205 keeps the
+    # tracing-hazard numbering but rides this family's runner)
+    "SPMD601": ("spmd", "Collective (sync_global_processes/orbax "
+                        "save/wait/close/multihost_utils/"
+                        "jax.distributed) reached only under a "
+                        "process_index/rank-keyed branch"),
+    "JAX205": ("spmd", "Module-level statement reaches a jax "
+                       "computation — XLA backend initialized at "
+                       "import time"),
 }
 
-FAMILIES = ("gin", "jax", "concurrency", "imports", "obs")
+FAMILIES = ("gin", "jax", "concurrency", "imports", "obs", "fleet",
+            "spmd")
 
 
 def rules_for_family(family: str) -> List[str]:

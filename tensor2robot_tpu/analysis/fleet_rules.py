@@ -1,0 +1,232 @@
+"""RPC wire-contract linting for the fleet plane (rules FLT5xx).
+
+The fleet's RPC layer is stringly typed on purpose — `client.call("m",
+payload)` on one side, `if method == "m":` inside a `handle()`
+dispatcher on the other — which keeps the wire format trivial but
+means a typo'd method name only surfaces as a runtime `RpcError`
+AFTER a full fleet spawn. These rules close that gap statically:
+
+  * FLT501 — a string-literal `.call("m")` / `.call_once("m")` site
+    whose method name no `handle()` dispatcher in scope accepts.
+  * FLT502 — a dispatcher arm (`method == "m"` / `method in (...)`)
+    whose method name no call site in scope ever sends (dead handler;
+    informational, but dead arms hide real wire-contract drift).
+
+Resolution is a UNION across every dispatcher found in scope: host.py
+carries two (the serving host and the replay-shard service), front.py
+one, and callers don't statically know which server a client socket
+reaches — a method handled by ANY dispatcher is deliverable. The
+synthetic disconnect method (`rpc.DISCONNECT_METHOD`, dispatched
+server-side on connection close, never dialled by clients) is exempt
+from FLT502; comparisons against `X.DISCONNECT_METHOD` resolve to its
+module-level string constant so dispatchers stay literal-free there.
+
+Call sites route through wrappers: `Orchestrator._aux_call(entry,
+"slo_report")` forwards its `method` parameter into `client.call`.
+A fixpoint marks any function passing one of its own parameters as
+the method argument of a `.call`/`.call_once` (or of another
+forwarder) as a forwarder, and string literals at its statically
+resolvable call sites count as wire sends.
+
+Both rules stay silent when scope is too narrow to judge: FLT501
+needs at least one dispatcher in the scanned tree, FLT502 at least
+one call site — otherwise a `--paths` subset run would spray noise.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tensor2robot_tpu.analysis.astutil import (
+    FunctionInfo,
+    Module,
+    dotted_name,
+    modules_by_dotted_path,
+    parse_tree,
+    resolve_callee,
+)
+from tensor2robot_tpu.analysis.findings import Finding
+
+_CALL_ATTRS = ("call", "call_once")
+_DISCONNECT_CONSTANT = "DISCONNECT_METHOD"
+_DEFAULT_DISCONNECT = "__disconnect__"
+
+
+def _is_rpc_send(call: ast.Call) -> bool:
+  """`<receiver>.call(...)` / `.call_once(...)` — attribute form only,
+  so a bare local helper named `call(...)` doesn't register."""
+  name = dotted_name(call.func)
+  return bool(name and "." in name
+              and name.rsplit(".", 1)[1] in _CALL_ATTRS)
+
+
+def _disconnect_values(modules: Sequence[Module]) -> Set[str]:
+  """Module-level `DISCONNECT_METHOD = "<lit>"` constants in scope."""
+  values = {_DEFAULT_DISCONNECT}
+  for module in modules:
+    for node in module.tree.body:
+      if not isinstance(node, ast.Assign):
+        continue
+      if not (isinstance(node.value, ast.Constant)
+              and isinstance(node.value.value, str)):
+        continue
+      for target in node.targets:
+        if isinstance(target, ast.Name) \
+            and target.id == _DISCONNECT_CONSTANT:
+          values.add(node.value.value)
+  return values
+
+
+def _is_dispatcher(func: FunctionInfo) -> bool:
+  return func.name == "handle" and bool(func.params) \
+      and func.params[0] == "method"
+
+
+def _handled_methods(func: FunctionInfo, disconnect: Set[str]
+                     ) -> List[Tuple[str, int]]:
+  """(method, lineno) accepted by one dispatcher: `method == "m"`,
+  `method in ("a", "b")`, and `method == X.DISCONNECT_METHOD`."""
+  handled: List[Tuple[str, int]] = []
+  for node in ast.walk(func.node):
+    if not (isinstance(node, ast.Compare) and len(node.ops) == 1):
+      continue
+    sides = (node.left, node.comparators[0])
+    if isinstance(node.ops[0], ast.Eq):
+      if not any(isinstance(s, ast.Name) and s.id == "method"
+                 for s in sides):
+        continue
+      for side in sides:
+        if isinstance(side, ast.Constant) \
+            and isinstance(side.value, str):
+          handled.append((side.value, node.lineno))
+        else:
+          name = dotted_name(side)
+          if name and name.rsplit(".", 1)[-1] == _DISCONNECT_CONSTANT:
+            handled.extend((v, node.lineno) for v in sorted(disconnect))
+    elif isinstance(node.ops[0], ast.In):
+      if not (isinstance(node.left, ast.Name)
+              and node.left.id == "method"):
+        continue
+      container = node.comparators[0]
+      if isinstance(container, (ast.Tuple, ast.List, ast.Set)):
+        handled.extend(
+            (elt.value, node.lineno) for elt in container.elts
+            if isinstance(elt, ast.Constant)
+            and isinstance(elt.value, str))
+  return handled
+
+
+def _forwarders(modules: Sequence[Module],
+                by_dotted: Dict[str, Module]
+                ) -> Dict[Tuple[int, str], int]:
+  """(id(module), qualname) -> index of the forwarded method param.
+
+  Seed: a function passing one of its own parameters as the first
+  argument of `.call`/`.call_once`. Fixpoint: a function passing a
+  parameter into a known forwarder's method slot is itself one.
+  """
+  forwarders: Dict[Tuple[int, str], int] = {}
+  ordered = [(module, module.functions[qual])
+             for module in modules
+             for qual in sorted(module.functions)]
+  changed = True
+  while changed:
+    changed = False
+    for module, func in ordered:
+      key = (id(module), func.qualname)
+      if key in forwarders:
+        continue
+      for node in ast.walk(func.node):
+        if not isinstance(node, ast.Call):
+          continue
+        arg = _method_argument(node, forwarders, by_dotted, module,
+                               func)
+        if isinstance(arg, ast.Name) and arg.id in func.params:
+          forwarders[key] = func.params.index(arg.id)
+          changed = True
+          break
+  return forwarders
+
+
+def _method_argument(call: ast.Call,
+                     forwarders: Dict[Tuple[int, str], int],
+                     by_dotted: Dict[str, Module], module: Module,
+                     func: Optional[FunctionInfo]
+                     ) -> Optional[ast.AST]:
+  """The expression in this call's method slot, if it has one —
+  arg 0 of a raw `.call`/`.call_once`, or the forwarded-parameter
+  position of a resolvable call to a known forwarder."""
+  if _is_rpc_send(call):
+    return call.args[0] if call.args else None
+  target = resolve_callee(by_dotted, module, func, call)
+  if target is None:
+    return None
+  index = forwarders.get((id(target[0]), target[1]))
+  if index is None:
+    return None
+  if index < len(call.args):
+    return call.args[index]
+  param = target[0].functions[target[1]].params[index]
+  for kw in call.keywords:
+    if kw.arg == param:
+      return kw.value
+  return None
+
+
+def run_fleet_rules(paths: Sequence[str], root: str) -> List[Finding]:
+  modules = parse_tree(paths, root)
+  by_dotted = modules_by_dotted_path(modules)
+  disconnect = _disconnect_values(modules)
+  forwarders = _forwarders(modules, by_dotted)
+
+  # The union wire contract: every dispatcher arm in scope.
+  handled: Dict[str, List[Tuple[Module, FunctionInfo, int]]] = {}
+  dispatchers = 0
+  for module in modules:
+    for qual in sorted(module.functions):
+      func = module.functions[qual]
+      if not _is_dispatcher(func):
+        continue
+      dispatchers += 1
+      for method, lineno in _handled_methods(func, disconnect):
+        handled.setdefault(method, []).append((module, func, lineno))
+
+  # Every literal send: raw `.call("m")` sites plus literals flowing
+  # through forwarder parameters.
+  sends: List[Tuple[str, Module, int, str]] = []
+  for module in modules:
+    for qual in sorted(module.functions):
+      func = module.functions[qual]
+      for node in ast.walk(func.node):
+        if not isinstance(node, ast.Call):
+          continue
+        arg = _method_argument(node, forwarders, by_dotted, module,
+                               func)
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+          sends.append((arg.value, module, node.lineno, func.qualname))
+
+  findings: List[Finding] = []
+  if dispatchers:
+    for method, module, lineno, scope in sends:
+      if method in handled or method in disconnect:
+        continue
+      findings.append(Finding(
+          "FLT501", module.rel, lineno, scope,
+          f"rpc method {method!r} is sent here but no `handle()` "
+          f"dispatcher in scope accepts it ({dispatchers} dispatcher(s)"
+          " checked) — this call can only raise RpcError after a full "
+          "fleet spawn"))
+  if sends:
+    sent_names = {method for method, *_ in sends}
+    for method in sorted(handled):
+      if method in sent_names or method in disconnect:
+        continue
+      for module, func, lineno in handled[method]:
+        findings.append(Finding(
+            "FLT502", module.rel, lineno, func.qualname,
+            f"dispatcher arm for rpc method {method!r} is never sent "
+            "by any `.call`/`.call_once` site in scope — dead handler "
+            "(or the caller went through a path this lint can't "
+            "resolve; pragma with the caller named)"))
+  return findings

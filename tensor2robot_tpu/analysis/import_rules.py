@@ -18,7 +18,7 @@ chain so the fix is obvious.
 from __future__ import annotations
 
 import os
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from tensor2robot_tpu.analysis.astutil import parse_module
 from tensor2robot_tpu.analysis.findings import Finding
@@ -114,6 +114,42 @@ def _find_banned_chain(start: str, root: str,
           seen.add(target)
           frontier.append((target, chain + [target]))
   return None
+
+
+def import_closure(start: str, root: str) -> Set[str]:
+  """Every project module whose module-level code executes when
+  `start` is imported: BFS over module-level project imports, with
+  ancestor packages included (importing `a.b.c` executes `a` and
+  `a.b` first). Returns an empty set when `start` has no file under
+  `root` — scanning a fixture tree must not inherit repo facts.
+
+  This is what lets JAX205 (spmd_rules) tag import-time backend
+  hazards that sit in the entry binary's SPAWN closure — the computed
+  graph replaces any hand-maintained module list, so a new module
+  joining the entry graph is covered the day it lands.
+  """
+  if _module_file(start, root) is None:
+    return set()
+  project = start.split(".")[0]
+  cache: Dict[str, List[str]] = {}
+  seen: Set[str] = set()
+  frontier: List[str] = []
+
+  def admit(dotted: str) -> None:
+    parts = dotted.split(".")
+    for i in range(1, len(parts) + 1):
+      ancestor = ".".join(parts[:i])
+      if ancestor not in seen and _module_file(ancestor, root):
+        seen.add(ancestor)
+        frontier.append(ancestor)
+
+  admit(start)
+  while frontier:
+    current = frontier.pop(0)
+    for imported in _module_level_imports(current, root, cache):
+      if imported.split(".")[0] == project:
+        admit(imported)
+  return seen
 
 
 def run_import_rules(root: str,

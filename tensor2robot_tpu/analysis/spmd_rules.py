@@ -1,0 +1,400 @@
+"""Distributed SPMD-correctness rules (SPMD601, JAX205).
+
+Both rules statically decide hazards PR 19 paid for at runtime:
+
+  * SPMD601 — a call that (transitively) reaches a COLLECTIVE —
+    `sync_global_processes`, an orbax writer's `save`/`wait`/`close`
+    (barriers live inside them), `multihost_utils.*`,
+    `jax.distributed.*` — from inside a conditional keyed on the
+    process identity (`jax.process_index()` / `process_count()` /
+    a `chief`/`rank` name). Collectives are rendezvous points: when
+    only a subset of ranks enters one, the participants wedge inside
+    the barrier while the rest train on. Reachability is the CON303
+    interprocedural fixpoint, so the collective may hide any number
+    of calls below the gate.
+  * JAX205 — a module-level statement whose call target reaches a
+    `jnp.*`/`jax.*` COMPUTATION (not a mere import): it initializes
+    the XLA backend in every importing process. For modules in the
+    entry binary's spawn import closure that is fatal, not just slow —
+    multiprocessing's spawn re-imports `__main__` in every child
+    BEFORE `jax.distributed.initialize`, which raises on an already-
+    initialized backend. The closure is COMPUTED (the module-level
+    import BFS shared with IMP401), so new modules joining the entry
+    graph are covered automatically; the dynamic twin is
+    tests/test_fleet.py's subprocess backend-free pin.
+
+Precision limits (documented in docs/ANALYSIS.md): gates are lexical
+`if` branches — an early `if not chief: return` divergence is not
+seen; gate names are nominal (`chief`/`rank`/...) plus names assigned
+from a `process_index()`/`process_count()` expression in the same
+function; orbax writers are recognized by receiver name
+(`*writer*`/`*checkpoint*`/`*ckpt*`/`*manager*`), not type inference.
+`jax.process_count()`-keyed gates ARE flagged even though the count is
+uniform across ranks — a correct count-gated collective earns an
+inline pragma saying so.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from tensor2robot_tpu.analysis.astutil import (
+    FunctionInfo,
+    Module,
+    dotted_name,
+    modules_by_dotted_path,
+    parse_tree,
+    resolve_callee,
+)
+from tensor2robot_tpu.analysis.findings import Finding
+
+# The binary whose spawn closure must stay backend-free (every fleet
+# child re-imports it as __main__ before jax.distributed comes up).
+ENTRY_BINARY = "tensor2robot_tpu.bin.run_t2r_trainer"
+
+_FnKey = Tuple[int, str]
+
+# ---------------------------------------------------------------------------
+# Collective seeds (SPMD601)
+# ---------------------------------------------------------------------------
+
+_COLLECTIVE_SUFFIXES = ("sync_global_processes", "wait_until_finished")
+_COLLECTIVE_PREFIXES = (
+    "jax.distributed.",
+    "jax.experimental.multihost_utils.",
+    "multihost_utils.",
+)
+# Nominal orbax-writer receivers: `writer.save(...)` et al. carry
+# `sync_global_processes` barriers inside (utils/checkpoints.py).
+_WRITER_RECEIVER_RE = re.compile(r"writer|checkpoint|ckpt|manager",
+                                 re.IGNORECASE)
+_WRITER_METHODS = ("save", "wait", "close", "wait_until_finished")
+
+
+def _collective_call(module: Module, call: ast.Call) -> Optional[str]:
+  """The display name of a collective call, else None."""
+  name = dotted_name(call.func)
+  if not name:
+    return None
+  expanded = module.expand(name) or name
+  last = name.rsplit(".", 1)[-1]
+  if last in _COLLECTIVE_SUFFIXES:
+    return name
+  for prefix in _COLLECTIVE_PREFIXES:
+    if expanded.startswith(prefix):
+      return name
+  if "." in name and last in _WRITER_METHODS:
+    receiver = name.split(".")[-2]
+    if _WRITER_RECEIVER_RE.search(receiver):
+      return name
+  return None
+
+
+# ---------------------------------------------------------------------------
+# Backend-computation seeds (JAX205)
+# ---------------------------------------------------------------------------
+
+# jax namespaces that are pure bookkeeping at call time — registering
+# pytrees, flipping config flags, describing shardings — never a
+# device computation.
+_BACKEND_EXEMPT_PREFIXES = (
+    "jax.tree_util.",
+    "jax.tree.",
+    "jax.config.",
+    "jax.typing.",
+    "jax.dtypes.",
+    "jax.sharding.",
+)
+# Lazy wrappers: calling them builds a traced callable, it does not
+# run one (`fn = jax.jit(fn)` at module level is the idiomatic form).
+_BACKEND_LAZY = frozenset({
+    "jax.jit", "jax.pjit", "jax.grad", "jax.value_and_grad",
+    "jax.vmap", "jax.pmap", "jax.checkpoint", "jax.remat",
+    "jax.custom_vjp", "jax.custom_jvp", "jax.named_call",
+    "jax.eval_shape", "jax.ShapeDtypeStruct",
+    "jax.experimental.shard_map.shard_map",
+})
+# Namespaces whose calls ARE computations (jnp expands to jax.numpy
+# through the import table) plus the device-touching jax.* entries.
+_BACKEND_PREFIXES = (
+    "jax.numpy.", "jax.random.", "jax.lax.", "jax.nn.", "jax.scipy.",
+    "jax.image.", "jax.ops.", "jax.distributed.",
+    "jax.experimental.multihost_utils.",
+)
+_BACKEND_EXACT = frozenset({
+    "jax.devices", "jax.local_devices", "jax.device_count",
+    "jax.local_device_count", "jax.default_backend", "jax.device_put",
+    "jax.device_get", "jax.block_until_ready", "jax.process_index",
+    "jax.process_count", "jax.make_mesh", "jax.clear_caches",
+})
+
+
+def _backend_call(module: Module, call: ast.Call) -> Optional[str]:
+  """The display name of a backend-initializing jax call, else None."""
+  name = dotted_name(call.func)
+  if not name:
+    return None
+  expanded = module.expand(name) or name
+  if not expanded.startswith("jax."):
+    return None
+  for prefix in _BACKEND_EXEMPT_PREFIXES:
+    if expanded.startswith(prefix):
+      return None
+  if expanded in _BACKEND_LAZY:
+    return None
+  if expanded in _BACKEND_EXACT:
+    return name
+  for prefix in _BACKEND_PREFIXES:
+    if expanded.startswith(prefix):
+      return name
+  return None
+
+
+# ---------------------------------------------------------------------------
+# Shared reachability fixpoint (the CON303 pattern)
+# ---------------------------------------------------------------------------
+
+def _reaches(modules: Sequence[Module],
+             by_dotted: Dict[str, Module],
+             seed: Callable[[Module, ast.Call], Optional[str]]
+             ) -> Dict[_FnKey, str]:
+  """(id(module), qualname) -> witness chain for every function that
+  eventually (itself or through resolvable callees) hits a seed call.
+  Iteration order is fixed, so witness strings are deterministic."""
+  ordered = [(m, m.functions[q])
+             for m in modules for q in sorted(m.functions)]
+  witness: Dict[_FnKey, str] = {}
+  calls: Dict[_FnKey, List[Tuple[_FnKey, str]]] = {}
+  for module, func in ordered:
+    key = (id(module), func.qualname)
+    callees: List[Tuple[_FnKey, str]] = []
+    for node in ast.walk(func.node):
+      if not isinstance(node, ast.Call):
+        continue
+      if key not in witness:
+        label = seed(module, node)
+        if label:
+          witness[key] = (f"`{label}` (line {node.lineno} of "
+                          f"{module.rel})")
+          continue
+      target = resolve_callee(by_dotted, module, func, node)
+      if target is not None:
+        callees.append(((id(target[0]), target[1]), target[1]))
+    calls[key] = callees
+  changed = True
+  while changed:
+    changed = False
+    for module, func in ordered:
+      key = (id(module), func.qualname)
+      if key in witness:
+        continue
+      for callee_key, callee_qual in calls[key]:
+        if callee_key in witness:
+          witness[key] = f"{callee_qual} -> {witness[callee_key]}"
+          changed = True
+          break
+  return witness
+
+
+# ---------------------------------------------------------------------------
+# SPMD601 — chief-gated collective
+# ---------------------------------------------------------------------------
+
+_GATE_CALL_SUFFIXES = ("process_index", "process_count")
+_GATE_NAME_RE = re.compile(
+    r"(?:\A|_)(?:chief|rank|process_index|process_id)\Z",
+    re.IGNORECASE)
+
+
+def _gate_call(expr: ast.AST) -> Optional[str]:
+  for node in ast.walk(expr):
+    if isinstance(node, ast.Call):
+      name = dotted_name(node.func)
+      if name and name.rsplit(".", 1)[-1] in _GATE_CALL_SUFFIXES:
+        return name
+  return None
+
+
+def _assigned_gate_names(func: FunctionInfo) -> Set[str]:
+  """Names bound from a process-identity expression in this function
+  (`chief = jax.process_index() == 0` makes `chief` a gate)."""
+  names: Set[str] = set()
+  for node in ast.walk(func.node):
+    if isinstance(node, ast.Assign) and _gate_call(node.value):
+      for target in node.targets:
+        if isinstance(target, ast.Name):
+          names.add(target.id)
+  return names
+
+
+def _gate_token(test: ast.AST, gate_names: Set[str]) -> Optional[str]:
+  """The identity-divergent token a conditional is keyed on, if any."""
+  call = _gate_call(test)
+  if call:
+    return call + "()"
+  for node in ast.walk(test):
+    if isinstance(node, ast.Name) and (
+        node.id in gate_names or _GATE_NAME_RE.search(node.id)):
+      return node.id
+    if isinstance(node, ast.Attribute) \
+        and _GATE_NAME_RE.search(node.attr):
+      return dotted_name(node) or node.attr
+  return None
+
+
+def _spmd601(modules: Sequence[Module], by_dotted: Dict[str, Module],
+             witness: Dict[_FnKey, str],
+             findings: List[Finding]) -> None:
+  for module in modules:
+    for qual in sorted(module.functions):
+      func = module.functions[qual]
+      gate_names = _assigned_gate_names(func)
+
+      def emit(call: ast.Call, token: str) -> None:
+        label = _collective_call(module, call)
+        if label:
+          findings.append(Finding(
+              "SPMD601", module.rel, call.lineno, func.qualname,
+              f"collective `{label}` runs only under the `{token}` "
+              "gate: ranks outside the branch never reach the "
+              "rendezvous, participants wedge inside it (the PR-19 "
+              "chief-gated save class) — every rank must make the "
+              "call"))
+          return
+        target = resolve_callee(by_dotted, module, func, call)
+        if target is None:
+          return
+        chain = witness.get((id(target[0]), target[1]))
+        if chain is not None:
+          findings.append(Finding(
+              "SPMD601", module.rel, call.lineno, func.qualname,
+              f"call under the `{token}` gate reaches a collective: "
+              f"{target[1]} -> {chain} — ranks outside the branch "
+              "never reach the rendezvous, participants wedge inside "
+              "it (the PR-19 chief-gated save class)"))
+
+      def walk(node: ast.AST, token: Optional[str]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+          return  # a nested def's body doesn't run under this branch
+        if isinstance(node, ast.If):
+          inner = _gate_token(node.test, gate_names) or token
+          walk(node.test, token)  # the test runs under the OUTER gate
+          for stmt in node.body:
+            walk(stmt, inner)
+          for stmt in node.orelse:
+            # The else branch is the complementary rank subset —
+            # a collective there is torn the same way.
+            walk(stmt, inner)
+          return
+        if token is not None and isinstance(node, ast.Call):
+          emit(node, token)
+        for child in ast.iter_child_nodes(node):
+          walk(child, token)
+
+      for stmt in func.node.body:
+        walk(stmt, None)
+
+
+# ---------------------------------------------------------------------------
+# JAX205 — import-time backend init
+# ---------------------------------------------------------------------------
+
+def _is_main_guard(test: ast.AST) -> bool:
+  return (isinstance(test, ast.Compare)
+          and isinstance(test.left, ast.Name)
+          and test.left.id == "__name__"
+          and len(test.ops) == 1 and isinstance(test.ops[0], ast.Eq)
+          and isinstance(test.comparators[0], ast.Constant)
+          and test.comparators[0].value == "__main__")
+
+
+def _import_time_calls(node: ast.AST):
+  """Calls executed when the module is imported: module body
+  (recursing through if/try/loops/ClassDef), decorators and argument
+  defaults of defs — but not function/lambda bodies, and not the
+  `if __name__ == "__main__":` branch (spawn children import under
+  `__mp_main__`, so that branch never runs at import)."""
+  if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+    for dec in node.decorator_list:
+      yield from _import_time_calls(dec)
+    args = node.args
+    for default in (list(args.defaults)
+                    + [d for d in args.kw_defaults if d is not None]):
+      yield from _import_time_calls(default)
+    return
+  if isinstance(node, ast.Lambda):
+    return
+  if isinstance(node, ast.If) and _is_main_guard(node.test):
+    for stmt in node.orelse:
+      yield from _import_time_calls(stmt)
+    return
+  if isinstance(node, ast.Call):
+    yield node
+  for child in ast.iter_child_nodes(node):
+    yield from _import_time_calls(child)
+
+
+def _module_dotted(module: Module) -> str:
+  dotted = module.rel[:-3] if module.rel.endswith(".py") else module.rel
+  dotted = dotted.replace("/", ".")
+  if dotted.endswith(".__init__"):
+    dotted = dotted[: -len(".__init__")]
+  return dotted
+
+
+def _jax205(modules: Sequence[Module], by_dotted: Dict[str, Module],
+            witness: Dict[_FnKey, str], closure: Set[str],
+            findings: List[Finding]) -> None:
+  for module in modules:
+    in_closure = _module_dotted(module) in closure
+    for call in _import_time_calls(module.tree):
+      label = _backend_call(module, call)
+      if label:
+        detail = f"`{label}` is a jax computation"
+      else:
+        target = resolve_callee(by_dotted, module, None, call)
+        if target is None:
+          continue
+        chain = witness.get((id(target[0]), target[1]))
+        if chain is None:
+          continue
+        detail = (f"`{dotted_name(call.func)}` reaches a jax "
+                  f"computation: {target[1]} -> {chain}")
+      message = (f"module-level statement runs at import time and "
+                 f"{detail} — the XLA backend initializes in every "
+                 "importing process (demote to numpy or defer into "
+                 "the caller)")
+      if in_closure:
+        message += (
+            "; this module is in the entry binary's spawn import "
+            "closure, so every fleet child re-importing __main__ "
+            "breaks jax.distributed.initialize")
+      findings.append(Finding(
+          "JAX205", module.rel, call.lineno, "", message))
+
+
+# ---------------------------------------------------------------------------
+# Entry point
+# ---------------------------------------------------------------------------
+
+def run_spmd_rules(paths: Sequence[str], root: str) -> List[Finding]:
+  from tensor2robot_tpu.analysis.import_rules import import_closure
+
+  modules = parse_tree(paths, root)
+  by_dotted = modules_by_dotted_path(modules)
+  # `pkg/__init__.py` answers for `pkg` too, so `config.configurable`
+  # style targets resolve through package re-exports.
+  for key in list(by_dotted):
+    if key.endswith(".__init__"):
+      by_dotted.setdefault(key[: -len(".__init__")], by_dotted[key])
+
+  findings: List[Finding] = []
+  _spmd601(modules, by_dotted,
+           _reaches(modules, by_dotted, _collective_call), findings)
+  _jax205(modules, by_dotted,
+          _reaches(modules, by_dotted, _backend_call),
+          import_closure(ENTRY_BINARY, root), findings)
+  return findings

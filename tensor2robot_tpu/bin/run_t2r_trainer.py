@@ -100,6 +100,15 @@ def main(argv):
     # jax.distributed.initialize waiting for peers that aren't there.
     import sys
 
+    # Distributed pre-flight (ISSUE 20) runs FIRST and pure-AST —
+    # before the configurable families (and therefore jax) load, and
+    # long before any jax.distributed init: a typo'd rpc method or a
+    # chief-gated collective fails here in a second instead of as a
+    # wedged barrier minutes into a fleet spawn.
+    from tensor2robot_tpu.analysis import cli as t2rcheck_cli
+
+    dist_rc = t2rcheck_cli.main(["--checks", "fleet,spmd", "--quiet"])
+
     from tensor2robot_tpu.analysis import gin_check
 
     _import_configurable_families()
@@ -112,7 +121,7 @@ def main(argv):
       print(finding.render())
     print(f"validate_only: {len(findings)} finding(s) in "
           f"{len(configs)} config(s)")
-    sys.exit(1 if findings else 0)
+    sys.exit(1 if (findings or dist_rc) else 0)
   # Multi-host wiring comes first: jax.distributed must initialize
   # before any device use (SURVEY §3 "multi-slice via jax distributed
   # init"). Single-process runs no-op.

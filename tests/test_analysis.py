@@ -25,8 +25,16 @@ from tensor2robot_tpu.analysis.findings import (
     PragmaIndex,
     RULE_CATALOG,
 )
-from tensor2robot_tpu.analysis.import_rules import run_import_rules
+from tensor2robot_tpu.analysis.fleet_rules import run_fleet_rules
+from tensor2robot_tpu.analysis.import_rules import (
+    import_closure,
+    run_import_rules,
+)
 from tensor2robot_tpu.analysis.jax_rules import run_jax_rules
+from tensor2robot_tpu.analysis.spmd_rules import (
+    ENTRY_BINARY,
+    run_spmd_rules,
+)
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -649,6 +657,333 @@ class TestImportRules:
     assert [f.rule for f in found] == ["IMP401"]
     assert "tensor2robot_tpu.data.helper" in found[0].message
 
+  def test_import_closure_computed_from_entry_binary(self):
+    # The entry binary's spawn closure is COMPUTED, not enumerated:
+    # the module whose jnp constant broke PR 19's fleet spawn is in
+    # it, and so is everything the closure walks through — a new
+    # module joining the entry import graph is covered automatically.
+    closure = import_closure(ENTRY_BINARY, REPO_ROOT)
+    assert "tensor2robot_tpu.train_eval" in closure
+    assert ("tensor2robot_tpu.preprocessors.image_transformations"
+            in closure)
+    assert "tensor2robot_tpu" in closure  # ancestor packages execute
+
+  def test_import_closure_empty_off_repo(self, tmp_path):
+    # Fixture trees must not inherit repo facts.
+    assert import_closure(ENTRY_BINARY, str(tmp_path)) == set()
+
+
+# ---------------------------------------------------------------------------
+# Fleet RPC wire contract: FLT501/FLT502 (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+class TestFleetRules:
+
+  DISPATCHER = """
+      DISCONNECT_METHOD = "__disconnect__"
+
+
+      class Handler:
+
+        def handle(self, method, payload, ctx):
+          if method == "ping":
+            return 1
+          if method in ("alpha", "beta"):
+            return 2
+          if method == DISCONNECT_METHOD:
+            return None
+          raise ValueError(method)
+  """
+
+  def test_flt501_unhandled_method(self, tmp_path):
+    _write(tmp_path, "mod.py", self.DISPATCHER + """
+      def go(client):
+        client.call("pong", {})
+        client.call_once("alpha")
+        client.call("ping")
+        client.call("beta")
+    """)
+    found = run_fleet_rules([str(tmp_path)], str(tmp_path))
+    assert [f.rule for f in found] == ["FLT501"]
+    assert "'pong'" in found[0].message
+    assert found[0].scope == "go"
+
+  def test_flt501_negative_all_handled(self, tmp_path):
+    _write(tmp_path, "mod.py", self.DISPATCHER + """
+      def go(client):
+        client.call("ping")
+        client.call_once("alpha", {})
+        client.call("beta")
+    """)
+    assert run_fleet_rules([str(tmp_path)], str(tmp_path)) == []
+
+  def test_flt501_literal_through_forwarder(self, tmp_path):
+    # The orchestrator pattern: `_aux_call(entry, "m", ...)` forwards
+    # its method parameter into `client.call` — literals at the
+    # forwarder's call sites are wire sends.
+    _write(tmp_path, "mod.py", self.DISPATCHER + """
+      class Fleet:
+
+        def _aux_call(self, entry, method, payload=None):
+          client = self._clients[entry["name"]]
+          return client.call(method, payload)
+
+        def go(self, entry):
+          self._aux_call(entry, "ping")
+          self._aux_call(entry, "tpyo")
+          self._aux_call(entry, "alpha")
+          self._aux_call(entry, "beta")
+    """)
+    found = run_fleet_rules([str(tmp_path)], str(tmp_path))
+    assert [f.rule for f in found] == ["FLT501"]
+    assert "'tpyo'" in found[0].message
+
+  def test_flt502_dead_handler_and_disconnect_exempt(self, tmp_path):
+    _write(tmp_path, "mod.py", self.DISPATCHER + """
+      def go(client):
+        client.call("ping")
+        client.call("alpha")
+    """)
+    found = run_fleet_rules([str(tmp_path)], str(tmp_path))
+    # "beta" is handled but never sent; the server-synthesized
+    # disconnect method must NOT count as dead.
+    assert [f.rule for f in found] == ["FLT502"]
+    assert "'beta'" in found[0].message
+    assert found[0].scope == "Handler.handle"
+
+  def test_silent_without_dispatchers_in_scope(self, tmp_path):
+    # A --paths subset with no handle() in sight must not spray
+    # FLT501 over every send.
+    _write(tmp_path, "mod.py", """
+        def go(client):
+          client.call("anything", {})
+    """)
+    assert run_fleet_rules([str(tmp_path)], str(tmp_path)) == []
+
+  def test_silent_without_sends_in_scope(self, tmp_path):
+    # ...and a handler-only scope must not report every arm dead.
+    _write(tmp_path, "mod.py", self.DISPATCHER)
+    assert run_fleet_rules([str(tmp_path)], str(tmp_path)) == []
+
+  def test_repo_wire_contract_closes(self):
+    # The live contract: every literal send in fleet/ + serving/
+    # resolves against the dispatcher union, and no arm is dead —
+    # with zero pragmas.
+    found = run_fleet_rules(
+        [os.path.join(REPO_ROOT, "tensor2robot_tpu/fleet"),
+         os.path.join(REPO_ROOT, "tensor2robot_tpu/serving")],
+        REPO_ROOT)
+    assert found == []
+
+
+# ---------------------------------------------------------------------------
+# Distributed SPMD correctness: SPMD601/JAX205 (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+class TestSpmdRules:
+
+  def test_spmd601_chief_gated_save_transitive(self, tmp_path):
+    # The reverted PR-19 bug form: a chief-gated call reaching the
+    # orbax writer's collective save one hop down — rank 0 wedges in
+    # `sync_global_processes` while peers train on.
+    _write(tmp_path, "bug.py", """
+        import jax
+
+        def _flush(writer, state):
+          writer.save(0, state)
+
+        def train(writer, state):
+          if jax.process_index() == 0:
+            _flush(writer, state)
+    """)
+    found = run_spmd_rules([str(tmp_path)], str(tmp_path))
+    assert [f.rule for f in found] == ["SPMD601"]
+    assert "writer.save" in found[0].message
+    assert found[0].scope == "train"
+
+  def test_spmd601_direct_collective_under_assigned_gate(
+      self, tmp_path):
+    # `chief = jax.process_index() == 0` makes `chief` a gate name;
+    # the collective sits directly in the gated branch.
+    _write(tmp_path, "bug.py", """
+        import jax
+        from jax.experimental import multihost_utils
+
+        def train(state):
+          flag = jax.process_index() == 0
+          if flag:
+            multihost_utils.sync_global_processes("save")
+    """)
+    found = run_spmd_rules([str(tmp_path)], str(tmp_path))
+    assert [f.rule for f in found] == ["SPMD601"]
+    assert "sync_global_processes" in found[0].message
+
+  def test_spmd601_negative_every_rank_saves(self, tmp_path):
+    # HEAD's corrected pattern: the save is unconditional, the chief
+    # gate guards only host-side logging.
+    _write(tmp_path, "good.py", """
+        import jax
+
+        def train(writer, logger, state, step):
+          chief = jax.process_index() == 0
+          if chief:
+            logger.write("train", step)
+          writer.save(step, state)
+          writer.close()
+    """)
+    assert run_spmd_rules([str(tmp_path)], str(tmp_path)) == []
+
+  def test_spmd601_rank_raise_guard_clean(self, tmp_path):
+    _write(tmp_path, "mod.py", """
+        def plan(rank, world_size):
+          if not 0 <= rank < world_size:
+            raise ValueError(f"bad rank {rank}")
+          return {"role": "learner" if rank == 0 else "peer"}
+    """)
+    assert run_spmd_rules([str(tmp_path)], str(tmp_path)) == []
+
+  def test_train_qtopt_head_clean_with_zero_pragmas(self):
+    # The acceptance pin: the every-rank-calls-save loop passes the
+    # rule on merit, not via suppression.
+    path = os.path.join(
+        REPO_ROOT, "tensor2robot_tpu/research/qtopt/train_qtopt.py")
+    assert run_spmd_rules([path], REPO_ROOT) == []
+    with open(path, encoding="utf-8") as f:
+      assert "disable=SPMD601" not in f.read()
+
+  def test_jax205_module_level_jnp_constant(self, tmp_path):
+    _write(tmp_path, "consts.py", """
+        import jax.numpy as jnp
+
+        YIQ = jnp.array([[0.299, 0.587, 0.114]])
+    """)
+    found = run_spmd_rules([str(tmp_path)], str(tmp_path))
+    assert [f.rule for f in found] == ["JAX205"]
+    assert "jnp.array" in found[0].message
+
+  def test_jax205_transitive_module_level_call(self, tmp_path):
+    _write(tmp_path, "table.py", """
+        import jax.numpy as jnp
+
+        def _build():
+          return jnp.eye(3)
+
+        TABLE = _build()
+    """)
+    found = run_spmd_rules([str(tmp_path)], str(tmp_path))
+    assert [f.rule for f in found] == ["JAX205"]
+    assert "_build" in found[0].message
+
+  def test_jax205_negatives(self, tmp_path):
+    # All the module-level shapes that must NOT flag: numpy
+    # constants, jnp inside functions, pytree registration, config
+    # flips, lazy jit wrapping, and the __main__ guard (spawn
+    # children import under __mp_main__, so it never runs).
+    _write(tmp_path, "ok.py", """
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        RGB = np.array([1.0, 2.0])
+        jax.tree_util.register_pytree_node(dict, id, id)
+        jax.config.update("jax_enable_x64", False)
+
+        def compute(x):
+          return jnp.asarray(x)
+
+        compute_fast = jax.jit(compute)
+
+        if __name__ == "__main__":
+          print(compute(jnp.ones(2)))
+    """)
+    assert run_spmd_rules([str(tmp_path)], str(tmp_path)) == []
+
+  def test_jax205_entry_closure_escalation(self, tmp_path):
+    # A seeded tree with its own entry binary: the hazard module is
+    # in the computed spawn closure, so the finding carries the
+    # jax.distributed escalation.
+    pkg = tmp_path / "tensor2robot_tpu"
+    (pkg / "bin").mkdir(parents=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "bin" / "__init__.py").write_text("")
+    (pkg / "bin" / "run_t2r_trainer.py").write_text(
+        "from tensor2robot_tpu import consts\n")
+    (pkg / "consts.py").write_text(
+        "import jax.numpy as jnp\nYIQ = jnp.array([1.0])\n")
+    found = run_spmd_rules([str(tmp_path)], str(tmp_path))
+    assert [f.rule for f in found] == ["JAX205"]
+    assert "spawn import closure" in found[0].message
+
+  def test_repo_spmd_clean(self):
+    # The whole package passes both rules with the baseline EMPTY.
+    found = run_spmd_rules(
+        [os.path.join(REPO_ROOT, "tensor2robot_tpu")], REPO_ROOT)
+    assert found == []
+
+  def test_pragma_suppresses_new_families(self, tmp_path):
+    _write(tmp_path, "mod.py", """
+        import jax.numpy as jnp
+
+        # count-gated uniform branch, documented:
+        # t2rcheck: disable=JAX205
+        YIQ = jnp.array([1.0])
+    """)
+    found = run_spmd_rules([str(tmp_path)], str(tmp_path))
+    active, suppressed = findings_lib.apply_pragmas(
+        found, str(tmp_path))
+    assert active == [] and [f.rule for f in suppressed] == ["JAX205"]
+
+  def test_fingerprints_survive_witness_line_motion(self):
+    # Witness chains embed "line N of file" — the fingerprint
+    # normalizer must strip the digits so baselines survive motion.
+    a = Finding("SPMD601", "a.py", 9, "train",
+                "reaches `writer.save` (line 5 of a.py)")
+    b = Finding("SPMD601", "a.py", 40, "train",
+                "reaches `writer.save` (line 88 of a.py)")
+    assert a.fingerprint() == b.fingerprint()
+
+  def test_cli_json_carries_new_rule_ids(self, tmp_path):
+    _write(tmp_path, "bad.py", """
+        import jax
+
+        DISCONNECT_METHOD = "__disconnect__"
+
+        class H:
+          def handle(self, method, payload, ctx):
+            if method == "ping":
+              return 1
+            raise ValueError(method)
+
+        def go(client):
+          client.call("pong")
+
+        def train(writer, state):
+          if jax.process_index() == 0:
+            writer.save(0, state)
+    """)
+    result = subprocess.run(
+        [sys.executable, "-m", "tensor2robot_tpu.analysis",
+         "--checks", "fleet,spmd", "--paths", str(tmp_path),
+         "--root", str(tmp_path), "--json"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    assert result.returncode == 1, result.stdout + result.stderr
+    payload = json.loads(result.stdout)
+    rules = {f["rule"] for f in payload["new"]}
+    assert {"FLT501", "FLT502", "SPMD601"} <= rules
+
+  def test_new_families_in_defaults_and_catalog(self):
+    from tensor2robot_tpu.analysis import cli
+
+    parser = cli.build_parser()
+    defaults = parser.get_default("checks")
+    assert "fleet" in defaults and "spmd" in defaults
+    assert cli._FLEET_PATHS == ("tensor2robot_tpu/fleet",
+                                "tensor2robot_tpu/serving")
+    for rule in ("FLT501", "FLT502", "SPMD601", "JAX205"):
+      assert rule in RULE_CATALOG
+    assert "fleet" in findings_lib.FAMILIES
+    assert "spmd" in findings_lib.FAMILIES
+
 
 # ---------------------------------------------------------------------------
 # Pragmas + baseline mechanics
@@ -732,7 +1067,8 @@ class TestCli:
     code = (
         "import sys\n"
         "from tensor2robot_tpu.analysis.cli import main\n"
-        "rc = main(['--checks', 'jax,concurrency,imports'])\n"
+        "rc = main(['--checks', 'jax,concurrency,imports,obs,"
+        "fleet,spmd'])\n"
         "assert 'jax' not in sys.modules, 'AST path imported jax'\n"
         "sys.exit(rc)\n")
     result = subprocess.run(

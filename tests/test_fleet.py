@@ -244,6 +244,10 @@ class TestActorImportClosure:
     # group's `jax.distributed.initialize` raise (found by driving
     # qtopt_fleet_hybrid.gin through the real run_t2r_trainer; a
     # module-level `jnp.array` constant was enough to trip it).
+    # This subprocess run is the e2e WITNESS; the static guarantee is
+    # JAX205 (analysis/spmd_rules.py), which scans the COMPUTED entry
+    # import closure so new modules are covered without editing any
+    # list here (tests/test_analysis.py::TestSpmdRules).
     code = (
         "import tensor2robot_tpu.bin.run_t2r_trainer; "
         "from jax._src import xla_bridge; "
